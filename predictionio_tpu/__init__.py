@@ -1,0 +1,23 @@
+"""predictionio_tpu — a TPU-native ML server framework.
+
+A ground-up JAX/XLA redesign of the capabilities of Apache PredictionIO
+(reference: /root/reference, Scala/Spark): an event-collection REST server over
+pluggable storage, a DASE engine abstraction (DataSource -> Preparator ->
+Algorithm(s) -> Serving, plus Evaluation), a train workflow running sharded
+JAX training over a TPU mesh, model checkpointing with engine-instance
+metadata, a deployed query server with resident device arrays, batch
+prediction, and a k-fold metric-evaluation workflow.
+
+Layer map (mirrors SURVEY.md section 1, rebuilt TPU-first):
+  L0 substrate   jax/XLA on a `jax.sharding.Mesh` (replaces Spark+Akka)
+  L1 backends    predictionio_tpu.storage.* (sqlite default; replaces JDBC/HBase/ES)
+  L2 data access predictionio_tpu.data.* (EventStore facades, aggregation)
+  L3 controller  predictionio_tpu.core.* (DASE protocols)
+  L4 workflow    predictionio_tpu.workflow.*
+  L5 servers     predictionio_tpu.server.* (event/query/admin REST)
+  L6 templates   predictionio_tpu.engines.* (recommendation/similarproduct/
+                 classification/ecommerce)
+  L7 CLI         predictionio_tpu.cli.* (`pio` command)
+"""
+
+__version__ = "0.1.0"

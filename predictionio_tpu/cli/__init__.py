@@ -1,0 +1,5 @@
+"""`pio` command-line interface (L7).
+
+Rebuilds the reference's tools/console CLI surface
+(tools/.../console/Console.scala:83-827) as a click application.
+"""

@@ -1,0 +1,32 @@
+"""`pio` CLI entry point.
+
+Command surface mirrors the reference console (Console.scala:134-623):
+app/accesskey/channel management, train, deploy, eval, batchpredict,
+eventserver, import/export, status. Commands are registered incrementally as
+the corresponding subsystems land; `pio version` and `pio status` work first.
+"""
+
+from __future__ import annotations
+
+import click
+
+from predictionio_tpu import __version__
+
+
+@click.group()
+def cli():
+    """predictionio_tpu — TPU-native ML server framework."""
+
+
+@cli.command()
+def version():
+    """Print framework version (Console.scala:134)."""
+    click.echo(__version__)
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

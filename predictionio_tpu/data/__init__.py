@@ -1,0 +1,23 @@
+"""Event model and data-access layer (L2).
+
+Rebuilds the reference's `data/` module (SURVEY.md section 2.2): the universal
+`Event` datum, the schemaless `DataMap` property bag, `$set/$unset/$delete`
+property aggregation, and bidirectional id maps for string->index assignment.
+"""
+
+from predictionio_tpu.data.datamap import DataMap, DataMapError, PropertyMap
+from predictionio_tpu.data.event import Event, EventValidationError, validate_event
+from predictionio_tpu.data.aggregator import aggregate_properties, aggregate_properties_single
+from predictionio_tpu.data.bimap import BiMap
+
+__all__ = [
+    "DataMap",
+    "DataMapError",
+    "PropertyMap",
+    "Event",
+    "EventValidationError",
+    "validate_event",
+    "aggregate_properties",
+    "aggregate_properties_single",
+    "BiMap",
+]

@@ -1,0 +1,95 @@
+"""Folding `$set/$unset/$delete` events into per-entity PropertyMaps.
+
+Behavioral parity with the reference's LEventAggregator
+(data/.../storage/LEventAggregator.scala:32-148) and the monoid-based
+PEventAggregator (PEventAggregator.scala:28-210). The semantics, per entity,
+over events sorted by event time:
+
+  * `$set`    — merge properties into the current map (later values win);
+                (re)creates the entity if currently deleted/absent
+  * `$unset`  — remove the named keys (no-op if entity currently absent)
+  * `$delete` — drop the entity entirely (subsequent `$set` recreates it)
+  * any other event — ignored for aggregation
+  * first_updated / last_updated — min/max event time over the special events
+
+Entities whose fold ends with no live map (never `$set`, or deleted last) are
+excluded from the result.
+
+This module provides the row-at-a-time fold used by the serving path; the
+training path reaches the same semantics through the columnar event log
+(predictionio_tpu.data.columnar).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Iterable, Optional
+
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event, millis
+
+#: Event names that drive aggregation (LEventAggregator.scala:91)
+AGGREGATOR_EVENT_NAMES = ("$set", "$unset", "$delete")
+
+
+class _Fold:
+    __slots__ = ("fields", "first", "last")
+
+    def __init__(self):
+        # fields is None <=> entity absent/deleted; {} is a live empty entity
+        self.fields: Optional[dict] = None
+        self.first: Optional[_dt.datetime] = None
+        self.last: Optional[_dt.datetime] = None
+
+    def step(self, e: Event) -> None:
+        name = e.event
+        if name not in ("$set", "$unset", "$delete"):
+            return
+        t = e.event_time
+        self.first = t if self.first is None or t < self.first else self.first
+        self.last = t if self.last is None or t > self.last else self.last
+        if name == "$set":
+            if self.fields is None:
+                self.fields = dict(e.properties.fields)
+            else:
+                self.fields.update(e.properties.fields)
+        elif name == "$unset":
+            if self.fields is not None:
+                for k in e.properties.key_set():
+                    self.fields.pop(k, None)
+        else:  # $delete
+            self.fields = None
+
+    def result(self) -> Optional[PropertyMap]:
+        if self.fields is None:
+            return None
+        return PropertyMap(self.fields, self.first, self.last)
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> Optional[PropertyMap]:
+    """Fold one entity's events (sorted by time here) into a PropertyMap.
+
+    Parity with LEventAggregator.aggregatePropertiesSingle
+    (LEventAggregator.scala:66-89).
+    """
+    fold = _Fold()
+    for e in sorted(events, key=lambda ev: millis(ev.event_time)):
+        fold.step(e)
+    return fold.result()
+
+
+def aggregate_properties(events: Iterable[Event]) -> Dict[str, PropertyMap]:
+    """Group events by entity_id and fold each group, keeping live entities.
+
+    Parity with LEventAggregator.aggregateProperties
+    (LEventAggregator.scala:42-62).
+    """
+    by_entity: Dict[str, list] = {}
+    for e in events:
+        by_entity.setdefault(e.entity_id, []).append(e)
+    out: Dict[str, PropertyMap] = {}
+    for entity_id, evs in by_entity.items():
+        pm = aggregate_properties_single(evs)
+        if pm is not None:
+            out[entity_id] = pm
+    return out

@@ -1,0 +1,108 @@
+"""Bidirectional maps and vectorized string->index assignment.
+
+Behavioral parity with the reference's BiMap
+(data/.../storage/BiMap.scala:28-167). Where the reference builds id maps by
+collecting an RDD to the driver (BiMap.scala:126-128), the rebuild assigns
+contiguous indices with `np.unique` over columnar arrays — a vectorized,
+deterministic (sorted-key) assignment that feeds static-shape device arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Generic, Iterable, Mapping, Sequence, Tuple, TypeVar
+
+import numpy as np
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class BiMap(Generic[K, V]):
+    """Immutable bidirectional map; values must be unique (BiMap.scala:28)."""
+
+    __slots__ = ("_forward", "_inverse")
+
+    def __init__(self, forward: Mapping[K, V], _inverse: "BiMap | None" = None):
+        self._forward = dict(forward)
+        if _inverse is None:
+            inv = {}
+            for k, v in self._forward.items():
+                if v in inv:
+                    raise ValueError(f"BiMap values must be unique: duplicate {v!r}")
+                inv[v] = k
+            self._inverse = inv
+        else:
+            self._inverse = _inverse
+
+    @property
+    def forward(self) -> Dict[K, V]:
+        return dict(self._forward)
+
+    def inverse(self) -> "BiMap[V, K]":
+        out = BiMap.__new__(BiMap)
+        out._forward = self._inverse
+        out._inverse = self._forward
+        return out
+
+    def __getitem__(self, key: K) -> V:
+        return self._forward[key]
+
+    def get(self, key: K, default=None):
+        return self._forward.get(key, default)
+
+    def get_opt(self, key: K):
+        return self._forward.get(key)
+
+    def contains(self, key: K) -> bool:
+        return key in self._forward
+
+    __contains__ = contains
+
+    def __len__(self) -> int:
+        return len(self._forward)
+
+    def __iter__(self):
+        return iter(self._forward)
+
+    def items(self):
+        return self._forward.items()
+
+    def take(self, n: int) -> "BiMap[K, V]":
+        sub = dict(list(self._forward.items())[:n])
+        return BiMap(sub)
+
+    def to_map(self) -> Dict[K, V]:
+        return dict(self._forward)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, BiMap) and self._forward == other._forward
+
+    def __repr__(self) -> str:
+        return f"BiMap({self._forward!r})"
+
+    # -- id assignment (BiMap.stringInt/stringLong parity, vectorized) ------
+    @classmethod
+    def string_int(cls, keys: Iterable[str]) -> "BiMap[str, int]":
+        """Assign contiguous ints [0, n) to distinct keys, sorted for determinism."""
+        uniq = np.unique(np.asarray(list(keys), dtype=object))
+        return cls({str(k): i for i, k in enumerate(uniq)})
+
+    string_long = string_int  # Python ints are unbounded
+
+    @classmethod
+    def string_double(cls, keys: Iterable[str]) -> "BiMap[str, float]":
+        uniq = np.unique(np.asarray(list(keys), dtype=object))
+        return cls({str(k): float(i) for i, k in enumerate(uniq)})
+
+
+def assign_indices(values: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized distinct-id assignment for the training path.
+
+    Returns (vocab, codes): `vocab` is the sorted array of distinct strings
+    and `codes[i]` the index of `values[i]` in `vocab`. This replaces the
+    reference's collect-to-driver BiMap build with one `np.unique` pass and is
+    the scalable path for 20M-rating id spaces (SURVEY.md section 7 hard parts).
+    """
+    arr = np.asarray(values)
+    vocab, codes = np.unique(arr, return_inverse=True)
+    return vocab, codes.astype(np.int32)

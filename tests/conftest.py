@@ -1,0 +1,28 @@
+"""Test fixture: force CPU jax with 8 virtual devices.
+
+The analog of the reference's local[*] Spark test fixture
+(e2/.../fixture/SharedSparkContext.scala:21-44): distributed logic
+(shard_map, mesh collectives) is exercised on host threads without TPUs.
+Must run before jax is first imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devices = np.array(jax.devices()[:8])
+    assert devices.size == 8, "conftest should have forced 8 host devices"
+    return Mesh(devices, axis_names=("data",))

@@ -1,0 +1,126 @@
+"""$set/$unset/$delete fold semantics.
+
+Mirrors the reference's LEventAggregatorSpec with the TestEvents fixture
+(data/src/test/.../storage/{TestEvents.scala,LEventAggregatorSpec.scala}):
+u1 = set/set/set/unset/set chain, u2 = set/unset/set, plus a $delete case.
+"""
+
+import datetime as dt
+
+from predictionio_tpu.data import (
+    DataMap,
+    Event,
+    PropertyMap,
+    aggregate_properties,
+    aggregate_properties_single,
+)
+
+UTC = dt.timezone.utc
+
+
+def t(base_ms: int, plus_days: int = 0) -> dt.datetime:
+    return dt.datetime.fromtimestamp(base_ms / 1000, tz=UTC) + dt.timedelta(days=plus_days)
+
+
+U1_BASE = 654321
+U2_BASE = 6543210
+
+
+def set_ev(eid, props, when):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=DataMap(props), event_time=when)
+
+
+def unset_ev(eid, keys, when):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=DataMap({k: None for k in keys}), event_time=when)
+
+
+def delete_ev(eid, when):
+    return Event(event="$delete", entity_type="user", entity_id=eid,
+                 event_time=when)
+
+
+# the reference TestEvents fixture, reproduced
+U1_EVENTS = [
+    set_ev("u1", {"a": 1, "b": "value2", "d": [1, 2, 3]}, t(U1_BASE)),
+    set_ev("u1", {"a": 2}, t(U1_BASE, 1)),
+    set_ev("u1", {"b": "value4"}, t(U1_BASE, 2)),
+    unset_ev("u1", ["b"], t(U1_BASE, 3)),
+    set_ev("u1", {"e": "new"}, t(U1_BASE, 4)),
+]
+U1_EXPECTED = {"a": 2, "d": [1, 2, 3], "e": "new"}
+
+U2_EVENTS = [
+    set_ev("u2", {"a": 21, "b": "value12", "d": [7, 5, 6]}, t(U2_BASE)),
+    unset_ev("u2", ["a"], t(U2_BASE, 1)),
+    set_ev("u2", {"b": "value9", "g": "new11"}, t(U2_BASE, 2)),
+]
+U2_EXPECTED = {"b": "value9", "d": [7, 5, 6], "g": "new11"}
+
+
+def test_aggregate_two_entities():
+    out = aggregate_properties(U1_EVENTS + U2_EVENTS)
+    assert set(out) == {"u1", "u2"}
+    assert out["u1"].fields == U1_EXPECTED
+    assert out["u2"].fields == U2_EXPECTED
+
+
+def test_aggregate_property_map_times():
+    out = aggregate_properties(U1_EVENTS + U2_EVENTS)
+    assert out["u1"] == PropertyMap(U1_EXPECTED, t(U1_BASE), t(U1_BASE, 4))
+    assert out["u2"] == PropertyMap(U2_EXPECTED, t(U2_BASE), t(U2_BASE, 2))
+
+
+def test_aggregate_order_independent():
+    shuffled = list(reversed(U1_EVENTS + U2_EVENTS))
+    out = aggregate_properties(shuffled)
+    assert out["u1"].fields == U1_EXPECTED
+    assert out["u2"].fields == U2_EXPECTED
+
+
+def test_deleted_entity_excluded():
+    deleted = U1_EVENTS + [delete_ev("u1", t(U1_BASE, 5))]
+    out = aggregate_properties(deleted + U2_EVENTS)
+    assert set(out) == {"u2"}
+
+
+def test_set_after_delete_recreates():
+    evs = U1_EVENTS + [
+        delete_ev("u1", t(U1_BASE, 5)),
+        set_ev("u1", {"z": 9}, t(U1_BASE, 6)),
+    ]
+    out = aggregate_properties(evs)
+    # delete wipes history; only post-delete fields survive
+    assert out["u1"].fields == {"z": 9}
+    assert out["u1"].first_updated == t(U1_BASE)
+    assert out["u1"].last_updated == t(U1_BASE, 6)
+
+
+def test_unset_on_absent_entity_is_noop():
+    out = aggregate_properties([unset_ev("u9", ["a"], t(U1_BASE))])
+    assert out == {}
+
+
+def test_non_special_events_ignored():
+    evs = U1_EVENTS + [
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=t(U1_BASE, 10)),
+    ]
+    out = aggregate_properties(evs)
+    assert out["u1"].fields == U1_EXPECTED
+    # non-special events do not advance lastUpdated
+    assert out["u1"].last_updated == t(U1_BASE, 4)
+
+
+def test_single_entity():
+    pm = aggregate_properties_single(U1_EVENTS)
+    assert pm == PropertyMap(U1_EXPECTED, t(U1_BASE), t(U1_BASE, 4))
+    assert aggregate_properties_single([delete_ev("u1", t(U1_BASE))]) is None
+    assert aggregate_properties_single([]) is None
+
+
+def test_set_empty_properties_keeps_entity_alive():
+    out = aggregate_properties([set_ev("u1", {}, t(U1_BASE))])
+    assert out["u1"].fields == {}

@@ -1,0 +1,59 @@
+"""BiMap semantics (mirrors reference BiMapSpec coverage)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import BiMap
+from predictionio_tpu.data.bimap import assign_indices
+
+
+def test_forward_and_inverse():
+    bm = BiMap({"a": 1, "b": 2})
+    assert bm["a"] == 1
+    assert bm.inverse()[2] == "b"
+    assert bm.inverse().inverse()["a"] == 1
+
+
+def test_duplicate_values_rejected():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_get_and_contains():
+    bm = BiMap({"a": 1})
+    assert bm.get("a") == 1
+    assert bm.get("z") is None
+    assert bm.get_opt("z") is None
+    assert "a" in bm
+    assert "z" not in bm
+    assert len(bm) == 1
+
+
+def test_string_int_assignment():
+    bm = BiMap.string_int(["zebra", "apple", "mango", "apple"])
+    # distinct, contiguous, deterministic (sorted keys)
+    assert sorted(bm.forward.values()) == [0, 1, 2]
+    assert bm["apple"] == 0
+    assert bm["mango"] == 1
+    assert bm["zebra"] == 2
+    assert bm.inverse()[0] == "apple"
+
+
+def test_string_double_assignment():
+    bm = BiMap.string_double(["b", "a"])
+    assert bm["a"] == 0.0
+    assert bm["b"] == 1.0
+
+
+def test_take():
+    bm = BiMap({"a": 1, "b": 2, "c": 3})
+    assert len(bm.take(2)) == 2
+
+
+def test_assign_indices_vectorized():
+    vocab, codes = assign_indices(["u3", "u1", "u3", "u2"])
+    assert list(vocab) == ["u1", "u2", "u3"]
+    assert list(codes) == [2, 0, 2, 1]
+    assert codes.dtype == np.int32
+    # round trip: vocab[codes] reconstructs input
+    assert list(vocab[codes]) == ["u3", "u1", "u3", "u2"]

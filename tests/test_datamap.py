@@ -1,0 +1,104 @@
+"""DataMap/PropertyMap semantics (mirrors reference DataMapSpec coverage)."""
+
+import dataclasses
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, DataMapError, PropertyMap
+
+
+def test_get_required_field():
+    dm = DataMap({"a": 1, "b": "x", "c": [1, 2, 3], "f": 2.5})
+    assert dm.get("a") == 1
+    assert dm.get("b", str) == "x"
+    assert dm.get("c", list) == [1, 2, 3]
+    assert dm.get("f", float) == 2.5
+    assert dm.get("a", float) == 1.0  # int widens to float
+
+
+def test_get_missing_raises():
+    dm = DataMap({"a": 1})
+    with pytest.raises(DataMapError):
+        dm.get("nope")
+
+
+def test_get_null_raises():
+    dm = DataMap({"a": None})
+    with pytest.raises(DataMapError):
+        dm.get("a")
+
+
+def test_get_wrong_type_raises():
+    dm = DataMap({"a": "str"})
+    with pytest.raises(DataMapError):
+        dm.get("a", int)
+
+
+def test_get_opt():
+    dm = DataMap({"a": 1, "b": None})
+    assert dm.get_opt("a") == 1
+    assert dm.get_opt("b") is None
+    assert dm.get_opt("missing") is None
+    assert dm.get_or_else("missing", 42) == 42
+
+
+def test_merge_right_wins():
+    a = DataMap({"x": 1, "y": 2})
+    b = DataMap({"y": 3, "z": 4})
+    assert (a | b).fields == {"x": 1, "y": 3, "z": 4}
+    assert a.merge({"y": 9}).fields == {"x": 1, "y": 9}
+
+
+def test_without():
+    dm = DataMap({"x": 1, "y": 2, "z": 3})
+    assert dm.without(["y", "z"]).fields == {"x": 1}
+
+
+def test_mapping_protocol_and_eq():
+    dm = DataMap({"x": 1})
+    assert "x" in dm
+    assert len(dm) == 1
+    assert dict(dm) == {"x": 1}
+    assert dm == DataMap({"x": 1})
+    assert dm == {"x": 1}
+    assert DataMap().is_empty
+
+
+def test_json_round_trip():
+    dm = DataMap({"a": 1, "b": [1, "x"], "c": {"n": None}})
+    assert DataMap.from_json(dm.to_json()) == dm
+    with pytest.raises(DataMapError):
+        DataMap.from_json("[1,2]")
+
+
+def test_non_json_value_rejected():
+    with pytest.raises(DataMapError):
+        DataMap({"a": object()})
+
+
+def test_extract_dataclass():
+    @dataclasses.dataclass
+    class Q:
+        user: str
+        num: int
+
+    q = DataMap({"user": "u1", "num": 5}).extract(Q)
+    assert q == Q("u1", 5)
+    with pytest.raises(DataMapError):
+        DataMap({"user": "u1"}).extract(Q)
+
+
+def test_property_map_carries_times():
+    t0 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    t1 = dt.datetime(2020, 1, 2, tzinfo=dt.timezone.utc)
+    pm = PropertyMap({"a": 1}, t0, t1)
+    assert pm.first_updated == t0
+    assert pm.last_updated == t1
+    assert pm.get("a") == 1
+    assert pm == PropertyMap({"a": 1}, t0, t1)
+    assert pm != PropertyMap({"a": 1}, t0, t0)
+    # equality is strict (transitive): a PropertyMap never equals a plain
+    # DataMap — compare .fields explicitly
+    assert pm != DataMap({"a": 1})
+    assert pm.fields == DataMap({"a": 1}).fields

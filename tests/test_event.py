@@ -1,0 +1,105 @@
+"""Event model and validation rules (parity with Event.scala:112-141)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event, EventValidationError, validate_event
+from predictionio_tpu.data.event import (
+    format_event_time,
+    is_reserved_prefix,
+    millis,
+    parse_event_time,
+)
+
+UTC = dt.timezone.utc
+
+
+def ev(**kw):
+    base = dict(event="view", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+def test_basic_event_valid():
+    validate_event(ev())
+    validate_event(ev(event="$set", properties=DataMap({"a": 1})))
+    validate_event(ev(target_entity_type="item", target_entity_id="i1"))
+
+
+@pytest.mark.parametrize("kw", [
+    dict(event=""),
+    dict(entity_type=""),
+    dict(entity_id=""),
+    dict(target_entity_type="", target_entity_id="i1"),
+    dict(target_entity_type="item", target_entity_id=""),
+    dict(target_entity_type="item"),                      # target type without id
+    dict(target_entity_id="i1"),                          # target id without type
+    dict(event="$unset"),                                 # $unset with no properties
+    dict(event="$custom"),                                # unknown reserved prefix
+    dict(event="pio_thing"),                              # pio_ reserved prefix
+    dict(event="$set", target_entity_type="item", target_entity_id="i1"),
+    dict(entity_type="pio_user"),                         # reserved entityType
+    dict(target_entity_type="pio_x", target_entity_id="i1"),
+    dict(properties=DataMap({"pio_score": 1})),           # reserved property
+])
+def test_invalid_events(kw):
+    with pytest.raises(EventValidationError):
+        validate_event(ev(**kw))
+
+
+def test_builtin_entity_type_allowed():
+    validate_event(ev(entity_type="pio_pr"))
+    validate_event(ev(target_entity_type="pio_pr", target_entity_id="x"))
+
+
+def test_json_round_trip():
+    e = ev(
+        target_entity_type="item",
+        target_entity_id="i1",
+        properties=DataMap({"rating": 4.5}),
+        event_time=dt.datetime(2021, 3, 4, 5, 6, 7, 123000, tzinfo=UTC),
+        tags=("a", "b"),
+        pr_id="pr-1",
+        event_id="e-1",
+    )
+    e2 = Event.from_json(e.to_json())
+    assert e2.event == e.event
+    assert e2.entity_type == e.entity_type
+    assert e2.target_entity_id == "i1"
+    assert e2.properties == e.properties
+    assert e2.event_time == e.event_time
+    assert e2.tags == ("a", "b")
+    assert e2.pr_id == "pr-1"
+    assert e2.event_id == "e-1"
+
+
+def test_from_dict_missing_fields():
+    with pytest.raises(EventValidationError):
+        Event.from_dict({"event": "view", "entityType": "user"})
+    with pytest.raises(EventValidationError):
+        Event.from_dict({"event": "view", "entityId": "u1"})
+    with pytest.raises(EventValidationError):
+        Event.from_dict({"entityType": "user", "entityId": "u1"})
+
+
+def test_naive_time_becomes_utc():
+    e = ev(event_time=dt.datetime(2020, 1, 1))
+    assert e.event_time.tzinfo == UTC
+
+
+def test_parse_format_time():
+    t = parse_event_time("2021-03-04T05:06:07.123Z")
+    assert t == dt.datetime(2021, 3, 4, 5, 6, 7, 123000, tzinfo=UTC)
+    assert "2021-03-04T05:06:07.123" in format_event_time(t)
+    # offset preserved
+    t2 = parse_event_time("2021-03-04T05:06:07+08:00")
+    assert millis(t2) == millis(dt.datetime(2021, 3, 3, 21, 6, 7, tzinfo=UTC))
+    with pytest.raises(EventValidationError):
+        parse_event_time("not a time")
+
+
+def test_reserved_prefix():
+    assert is_reserved_prefix("$set")
+    assert is_reserved_prefix("pio_x")
+    assert not is_reserved_prefix("view")

@@ -2,11 +2,14 @@
 
 Command surface mirrors the reference console (Console.scala:134-623):
 app/accesskey/channel management, train, deploy, eval, batchpredict,
-eventserver, import/export, status. Commands are registered incrementally as
-the corresponding subsystems land; `pio version` and `pio status` work first.
+eventserver, import/export, status. Training runs in-process (no
+spark-submit analog; SURVEY.md section 7 design mapping).
 """
 
 from __future__ import annotations
+
+import json
+import sys
 
 import click
 
@@ -22,6 +25,261 @@ def cli():
 def version():
     """Print framework version (Console.scala:134)."""
     click.echo(__version__)
+
+
+@cli.command()
+def status():
+    """Verify storage configuration (Console.scala:435, Management.scala:99)."""
+    from predictionio_tpu.storage import Storage
+    click.echo("[INFO] Inspecting predictionio_tpu installation...")
+    click.echo(f"[INFO] Version {__version__}")
+    try:
+        Storage.verify_all_data_objects()
+    except Exception as e:
+        click.echo(f"[ERROR] Unable to connect to all storage backends: {e}")
+        sys.exit(1)
+    click.echo("[INFO] All storage backends are properly configured.")
+    click.echo("[INFO] Your system is all ready to go.")
+
+
+# ---------------------------------------------------------------------------
+# app management (commands/App.scala:31-363)
+# ---------------------------------------------------------------------------
+
+@cli.group()
+def app():
+    """Manage apps (Console.scala:452-517)."""
+
+
+@app.command("new")
+@click.argument("name")
+@click.option("--id", "app_id", type=int, default=0, help="Preferred app id.")
+@click.option("--description", default=None)
+@click.option("--access-key", default="", help="Use this access key instead of generating one.")
+def app_new(name, app_id, description, access_key):
+    from predictionio_tpu.storage import AccessKey, App, Storage
+    apps = Storage.get_meta_data_apps()
+    if apps.get_by_name(name):
+        click.echo(f"[ERROR] App {name} already exists. Aborting.")
+        sys.exit(1)
+    new_id = apps.insert(App(id=app_id, name=name, description=description))
+    if new_id is None:
+        click.echo("[ERROR] Unable to create new app.")
+        sys.exit(1)
+    Storage.get_events().init_channel(new_id)
+    key = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key=access_key, appid=new_id, events=()))
+    if key is None:
+        click.echo(f"[ERROR] Access key {access_key} already exists. Aborting.")
+        Storage.get_events().remove_channel(new_id)
+        Storage.get_meta_data_apps().delete(new_id)
+        sys.exit(1)
+    click.echo("[INFO] Created a new app:")
+    click.echo(f"[INFO]         Name: {name}")
+    click.echo(f"[INFO]           ID: {new_id}")
+    click.echo(f"[INFO] Access Key: {key}")
+
+
+@app.command("list")
+def app_list():
+    from predictionio_tpu.storage import Storage
+    apps = Storage.get_meta_data_apps().get_all()
+    keys = Storage.get_meta_data_access_keys()
+    click.echo(f"[INFO] {'Name':<20} | {'ID':<4} | Access Key")
+    for a in sorted(apps, key=lambda x: x.name):
+        for k in keys.get_by_appid(a.id) or [None]:
+            key = k.key if k else ""
+            click.echo(f"[INFO] {a.name:<20} | {a.id:<4} | {key}")
+    click.echo(f"[INFO] Finished listing {len(apps)} app(s).")
+
+
+@app.command("show")
+@click.argument("name")
+def app_show(name):
+    from predictionio_tpu.storage import Storage
+    a = Storage.get_meta_data_apps().get_by_name(name)
+    if a is None:
+        click.echo(f"[ERROR] App {name} does not exist. Aborting.")
+        sys.exit(1)
+    click.echo(f"[INFO]     App Name: {a.name}")
+    click.echo(f"[INFO]       App ID: {a.id}")
+    click.echo(f"[INFO]  Description: {a.description or ''}")
+    for k in Storage.get_meta_data_access_keys().get_by_appid(a.id):
+        events = ",".join(k.events) if k.events else "(all)"
+        click.echo(f"[INFO]   Access Key: {k.key} | {events}")
+    for c in Storage.get_meta_data_channels().get_by_appid(a.id):
+        click.echo(f"[INFO]      Channel: {c.name} ({c.id})")
+
+
+@app.command("delete")
+@click.argument("name")
+@click.option("--force", "-f", is_flag=True)
+def app_delete(name, force):
+    from predictionio_tpu.storage import Storage
+    a = Storage.get_meta_data_apps().get_by_name(name)
+    if a is None:
+        click.echo(f"[ERROR] App {name} does not exist. Aborting.")
+        sys.exit(1)
+    if not force and not click.confirm(
+            f"Delete app {name} and ALL its data?"):
+        click.echo("[INFO] Aborted.")
+        return
+    events = Storage.get_events()
+    for c in Storage.get_meta_data_channels().get_by_appid(a.id):
+        events.remove_channel(a.id, c.id)
+        Storage.get_meta_data_channels().delete(c.id)
+    events.remove_channel(a.id)
+    for k in Storage.get_meta_data_access_keys().get_by_appid(a.id):
+        Storage.get_meta_data_access_keys().delete(k.key)
+    Storage.get_meta_data_apps().delete(a.id)
+    click.echo(f"[INFO] App {name} deleted.")
+
+
+@app.command("data-delete")
+@click.argument("name")
+@click.option("--channel", default=None)
+@click.option("--all", "delete_all", is_flag=True)
+@click.option("--force", "-f", is_flag=True)
+def app_data_delete(name, channel, delete_all, force):
+    from predictionio_tpu.storage import Storage
+    a = Storage.get_meta_data_apps().get_by_name(name)
+    if a is None:
+        click.echo(f"[ERROR] App {name} does not exist. Aborting.")
+        sys.exit(1)
+    if not force and not click.confirm(f"Delete data of app {name}?"):
+        click.echo("[INFO] Aborted.")
+        return
+    events = Storage.get_events()
+    if delete_all or channel is None:
+        events.remove_channel(a.id)
+        events.init_channel(a.id)
+        click.echo(f"[INFO] Deleted data of app {name} (default channel).")
+    if channel is not None or delete_all:
+        channels = Storage.get_meta_data_channels().get_by_appid(a.id)
+        if channel is not None and channel not in [c.name for c in channels]:
+            click.echo(f"[ERROR] Channel {channel} does not exist. Aborting.")
+            sys.exit(1)
+        for c in channels:
+            if delete_all or c.name == channel:
+                events.remove_channel(a.id, c.id)
+                events.init_channel(a.id, c.id)
+                click.echo(f"[INFO] Deleted data of channel {c.name}.")
+
+
+@app.command("channel-new")
+@click.argument("app_name")
+@click.argument("channel_name")
+def app_channel_new(app_name, channel_name):
+    from predictionio_tpu.storage import Channel, Storage
+    a = Storage.get_meta_data_apps().get_by_name(app_name)
+    if a is None:
+        click.echo(f"[ERROR] App {app_name} does not exist. Aborting.")
+        sys.exit(1)
+    try:
+        cid = Storage.get_meta_data_channels().insert(
+            Channel(id=0, name=channel_name, appid=a.id))
+    except ValueError as e:
+        click.echo(f"[ERROR] {e}")
+        sys.exit(1)
+    if cid is None:
+        click.echo(f"[ERROR] Channel {channel_name} already exists.")
+        sys.exit(1)
+    Storage.get_events().init_channel(a.id, cid)
+    click.echo(f"[INFO] Created channel {channel_name} ({cid}).")
+
+
+@app.command("channel-delete")
+@click.argument("app_name")
+@click.argument("channel_name")
+@click.option("--force", "-f", is_flag=True)
+def app_channel_delete(app_name, channel_name, force):
+    from predictionio_tpu.storage import Storage
+    a = Storage.get_meta_data_apps().get_by_name(app_name)
+    if a is None:
+        click.echo(f"[ERROR] App {app_name} does not exist. Aborting.")
+        sys.exit(1)
+    matched = [c for c in Storage.get_meta_data_channels().get_by_appid(a.id)
+               if c.name == channel_name]
+    if not matched:
+        click.echo(f"[ERROR] Channel {channel_name} does not exist.")
+        sys.exit(1)
+    if not force and not click.confirm(
+            f"Delete channel {channel_name} and its data?"):
+        click.echo("[INFO] Aborted.")
+        return
+    Storage.get_events().remove_channel(a.id, matched[0].id)
+    Storage.get_meta_data_channels().delete(matched[0].id)
+    click.echo(f"[INFO] Deleted channel {channel_name}.")
+
+
+# ---------------------------------------------------------------------------
+# accesskey management (commands/AccessKey.scala)
+# ---------------------------------------------------------------------------
+
+@cli.group()
+def accesskey():
+    """Manage access keys (Console.scala:554-592)."""
+
+
+@accesskey.command("new")
+@click.argument("app_name")
+@click.option("--key", default="")
+@click.option("--event", "events", multiple=True,
+              help="Allowed event names (default: all).")
+def accesskey_new(app_name, key, events):
+    from predictionio_tpu.storage import AccessKey, Storage
+    a = Storage.get_meta_data_apps().get_by_name(app_name)
+    if a is None:
+        click.echo(f"[ERROR] App {app_name} does not exist. Aborting.")
+        sys.exit(1)
+    k = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key=key, appid=a.id, events=tuple(events)))
+    if k is None:
+        click.echo("[ERROR] Unable to create access key.")
+        sys.exit(1)
+    click.echo(f"[INFO] Created new access key: {k}")
+
+
+@accesskey.command("list")
+@click.argument("app_name", required=False)
+def accesskey_list(app_name):
+    from predictionio_tpu.storage import Storage
+    keys = Storage.get_meta_data_access_keys()
+    if app_name:
+        a = Storage.get_meta_data_apps().get_by_name(app_name)
+        if a is None:
+            click.echo(f"[ERROR] App {app_name} does not exist. Aborting.")
+            sys.exit(1)
+        listing = keys.get_by_appid(a.id)
+    else:
+        listing = keys.get_all()
+    for k in listing:
+        events = ",".join(k.events) if k.events else "(all)"
+        click.echo(f"[INFO] {k.key} | app {k.appid} | {events}")
+    click.echo(f"[INFO] Finished listing {len(listing)} access key(s).")
+
+
+@accesskey.command("delete")
+@click.argument("key")
+def accesskey_delete(key):
+    from predictionio_tpu.storage import Storage
+    Storage.get_meta_data_access_keys().delete(key)
+    click.echo(f"[INFO] Deleted access key {key}.")
+
+
+# ---------------------------------------------------------------------------
+# servers
+# ---------------------------------------------------------------------------
+
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=7070, type=int)
+@click.option("--stats", is_flag=True, help="Enable hourly ingest statistics.")
+def eventserver(ip, port, stats):
+    """Launch the Event Server (Console.scala:384, EventServer.scala:552)."""
+    from predictionio_tpu.server.event_server import run_event_server
+    click.echo(f"[INFO] Creating Event Server at {ip}:{port}")
+    run_event_server(ip=ip, port=port, stats=stats)
 
 
 def main():

@@ -1,0 +1,95 @@
+"""Columnar event representation — the training-path data format.
+
+The reference's parallel read path returns RDD[Event]
+(data/.../storage/PEvents.scala:38-189). The TPU-native equivalent is a
+pyarrow Table: one columnar batch the host can filter/aggregate vectorized and
+convert to static-shape numpy/jax arrays feeding the device loader
+(SURVEY.md section 2.9 P2).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import UTC, Event, millis
+
+EVENT_SCHEMA = pa.schema([
+    ("event_id", pa.string()),
+    ("event", pa.string()),
+    ("entity_type", pa.string()),
+    ("entity_id", pa.string()),
+    ("target_entity_type", pa.string()),
+    ("target_entity_id", pa.string()),
+    ("properties", pa.string()),   # JSON; parsed lazily
+    ("event_time_ms", pa.int64()),
+    ("creation_time_ms", pa.int64()),
+])
+
+
+def events_to_table(events: Iterable[Event]) -> pa.Table:
+    cols = {name: [] for name in EVENT_SCHEMA.names}
+    for e in events:
+        cols["event_id"].append(e.event_id)
+        cols["event"].append(e.event)
+        cols["entity_type"].append(e.entity_type)
+        cols["entity_id"].append(e.entity_id)
+        cols["target_entity_type"].append(e.target_entity_type)
+        cols["target_entity_id"].append(e.target_entity_id)
+        cols["properties"].append(
+            None if e.properties.is_empty else e.properties.to_json())
+        cols["event_time_ms"].append(millis(e.event_time))
+        cols["creation_time_ms"].append(millis(e.creation_time))
+    return pa.table(cols, schema=EVENT_SCHEMA)
+
+
+def table_to_events(table: pa.Table) -> Iterator[Event]:
+    import datetime as dt
+
+    for row in table.to_pylist():
+        yield Event(
+            event_id=row["event_id"],
+            event=row["event"],
+            entity_type=row["entity_type"],
+            entity_id=row["entity_id"],
+            target_entity_type=row["target_entity_type"],
+            target_entity_id=row["target_entity_id"],
+            properties=(DataMap(json.loads(row["properties"]))
+                        if row["properties"] else DataMap()),
+            event_time=dt.datetime.fromtimestamp(row["event_time_ms"] / 1000, tz=UTC),
+            creation_time=dt.datetime.fromtimestamp(
+                row["creation_time_ms"] / 1000, tz=UTC),
+        )
+
+
+def property_column(table: pa.Table, key: str, dtype=np.float32) -> np.ndarray:
+    """Extract one numeric property from the JSON properties column."""
+    out = np.empty(table.num_rows, dtype=dtype)
+    props = table.column("properties").to_pylist()
+    for i, p in enumerate(props):
+        if p is None:
+            out[i] = np.nan
+        else:
+            out[i] = json.loads(p).get(key, np.nan)
+    return out
+
+
+def ratings_arrays(table: pa.Table, rating_key: str = "rating",
+                   default_rating: float = 1.0):
+    """(user_ids, item_ids, ratings) numpy views of an interaction table.
+
+    user = entity_id, item = target_entity_id; rows without a target are
+    dropped. Missing rating properties get `default_rating` (implicit
+    feedback events like view/like/buy).
+    """
+    targets = np.asarray(table.column("target_entity_id").to_pylist(), dtype=object)
+    mask = np.array([t is not None for t in targets], dtype=bool)
+    users = np.asarray(table.column("entity_id").to_pylist(), dtype=object)[mask]
+    items = targets[mask]
+    ratings = property_column(table, rating_key)[mask]
+    ratings = np.where(np.isnan(ratings), default_rating, ratings)
+    return users, items, ratings.astype(np.float32)

@@ -97,6 +97,8 @@ class Event:
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "Event":
+        if not isinstance(d, Mapping):
+            raise EventValidationError("event must be a JSON object")
         if "event" not in d:
             raise EventValidationError("field event is required")
         if "entityType" not in d:

@@ -1,0 +1,129 @@
+"""App-name-facing event store facades.
+
+Parity with the reference's engine-facing facades:
+  * `find_by_entity` / `find` <- LEventStore (data/.../store/LEventStore.scala:48-265),
+    the serving-time path
+  * `find_columnar` / `aggregate_properties` <- PEventStore
+    (data/.../store/PEventStore.scala:35-121), the training path
+  * app-name -> (app_id, channel_id) resolution <- store/Common.scala:25-60
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.storage.base import UNFILTERED, StorageError
+from predictionio_tpu.storage.registry import Storage
+
+_channel_cache: Dict[Tuple[str, Optional[str]], Tuple[int, Optional[int]]] = {}
+
+
+def resolve_app(app_name: str, channel_name: Optional[str] = None
+                ) -> Tuple[int, Optional[int]]:
+    """app name (+ optional channel name) -> (app_id, channel_id).
+
+    Cached, like store/Common.scala:25-60.
+    """
+    key = (app_name, channel_name)
+    if key in _channel_cache:
+        return _channel_cache[key]
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise StorageError(f"Invalid app name {app_name}")
+    channel_id = None
+    if channel_name is not None:
+        channels = Storage.get_meta_data_channels().get_by_appid(app.id)
+        matched = [c for c in channels if c.name == channel_name]
+        if not matched:
+            raise StorageError(
+                f"Invalid channel name {channel_name} for app {app_name}")
+        channel_id = matched[0].id
+    _channel_cache[key] = (app.id, channel_id)
+    return app.id, channel_id
+
+
+def clear_cache() -> None:
+    _channel_cache.clear()
+
+
+class EventStoreClient:
+    """Unified facade over the configured event store, by app name."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type=UNFILTERED,
+        target_entity_id=UNFILTERED,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]:
+        """PEventStore.find:59 / LEventStore.find:197 parity."""
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return Storage.get_events().find(
+            app_id=app_id, channel_id=channel_id,
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit, reversed_order=reversed_order)
+
+    @staticmethod
+    def find_by_entity(
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type=UNFILTERED,
+        target_entity_id=UNFILTERED,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+    ) -> Iterator[Event]:
+        """Serving-time entity lookup (LEventStore.findByEntity:76)."""
+        return EventStoreClient.find(
+            app_name=app_name, channel_name=channel_name,
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit, reversed_order=latest)
+
+    @staticmethod
+    def aggregate_properties(
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """PEventStore.aggregateProperties:87 parity."""
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return Storage.get_events().aggregate_properties(
+            app_id=app_id, channel_id=channel_id, entity_type=entity_type,
+            start_time=start_time, until_time=until_time, required=required)
+
+    @staticmethod
+    def find_columnar(app_name: str, channel_name: Optional[str] = None,
+                      **filters):
+        """Training-path columnar read (PEventStore.find -> pyarrow.Table)."""
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        return Storage.get_events().find_columnar(app_id, channel_id, **filters)
+
+
+# short aliases mirroring the reference object names
+PEventStore = EventStoreClient
+LEventStore = EventStoreClient

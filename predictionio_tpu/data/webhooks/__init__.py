@@ -1,0 +1,62 @@
+"""Webhook connectors: third-party payloads -> Events.
+
+Parity with the reference's webhooks package
+(data/.../webhooks/{JsonConnector,FormConnector,ConnectorUtil}.scala and the
+registry in data/.../api/WebhooksConnectors.scala:27-37). A connector turns
+one provider-specific payload (JSON body or form fields) into an Event dict;
+the event server validates and stores it.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Optional
+
+from predictionio_tpu.data.event import Event
+
+
+class ConnectorError(Exception):
+    """ConnectorException parity — payload cannot be converted."""
+
+
+class WebhookConnector(abc.ABC):
+    """Base connector; `form_based` selects form vs JSON body parsing."""
+
+    name: str = ""
+    form_based: bool = False
+
+    @abc.abstractmethod
+    def to_event_dict(self, payload: dict) -> dict:
+        """Convert provider payload to an Event wire dict (may raise
+        ConnectorError)."""
+
+    def to_event(self, payload: dict) -> Event:
+        return Event.from_dict(self.to_event_dict(payload))
+
+
+_REGISTRY: Dict[str, WebhookConnector] = {}
+
+
+def register_connector(connector: WebhookConnector) -> None:
+    _REGISTRY[connector.name] = connector
+
+
+def get_connector(name: str) -> Optional[WebhookConnector]:
+    _ensure_builtin()
+    return _REGISTRY.get(name)
+
+
+_loaded = False
+
+
+def _ensure_builtin() -> None:
+    """Built-in connector registry (WebhooksConnectors.scala:27-37)."""
+    global _loaded
+    if _loaded:
+        return
+    from predictionio_tpu.data.webhooks import segmentio, mailchimp, example
+    register_connector(segmentio.SegmentIOConnector())
+    register_connector(mailchimp.MailChimpConnector())
+    register_connector(example.ExampleJsonConnector())
+    register_connector(example.ExampleFormConnector())
+    _loaded = True

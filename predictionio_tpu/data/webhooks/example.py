@@ -1,0 +1,98 @@
+"""Example connectors (reference webhooks/examplejson, webhooks/exampleform).
+
+Payload shapes match the reference's documented examples
+(data/.../webhooks/examplejson/ExampleJsonConnector.scala:25-95,
+exampleform/ExampleFormConnector.scala:58-104): `userAction` and
+`userActionItem` types mapping to user events with optional context/properties.
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.data.webhooks import ConnectorError, WebhookConnector
+
+
+def _user_action(data, getter):
+    props = {}
+    context = getter(data, "context")
+    if context is not None:
+        props["context"] = context
+    for k in ("anotherProperty1", "anotherProperty2"):
+        v = getter(data, k)
+        if v is not None:
+            props[k] = v
+    out = {
+        "event": data["event"],
+        "entityType": "user",
+        "entityId": data["userId"],
+        "properties": props,
+    }
+    if data.get("timestamp"):
+        out["eventTime"] = data["timestamp"]
+    return out
+
+
+def _user_action_item(data, getter):
+    props = {}
+    context = getter(data, "context")
+    if context is not None:
+        props["context"] = context
+    for k in ("anotherPropertyA", "anotherPropertyB"):
+        v = getter(data, k)
+        if v is not None:
+            props[k] = v
+    out = {
+        "event": data["event"],
+        "entityType": "user",
+        "entityId": data["userId"],
+        "targetEntityType": "item",
+        "targetEntityId": data["itemId"],
+        "properties": props,
+    }
+    if data.get("timestamp"):
+        out["eventTime"] = data["timestamp"]
+    return out
+
+
+class ExampleJsonConnector(WebhookConnector):
+    name = "examplejson"
+    form_based = False
+
+    def to_event_dict(self, payload: dict) -> dict:
+        ptype = payload.get("type")
+        try:
+            if ptype == "userAction":
+                return _user_action(payload, lambda d, k: d.get(k))
+            if ptype == "userActionItem":
+                return _user_action_item(payload, lambda d, k: d.get(k))
+        except KeyError as e:
+            raise ConnectorError(
+                f"Cannot convert {payload} to event JSON: missing {e}") from e
+        raise ConnectorError(f"Cannot convert unknown type '{ptype}' to Event JSON.")
+
+
+class ExampleFormConnector(WebhookConnector):
+    name = "exampleform"
+    form_based = True
+
+    def to_event_dict(self, payload: dict) -> dict:
+        import json
+
+        def getter(d, k):
+            v = d.get(k)
+            if v is None:
+                return None
+            try:  # form values for context arrive as JSON strings
+                return json.loads(v)
+            except (json.JSONDecodeError, TypeError):
+                return v
+
+        ptype = payload.get("type")
+        try:
+            if ptype == "userAction":
+                return _user_action(payload, getter)
+            if ptype == "userActionItem":
+                return _user_action_item(payload, getter)
+        except KeyError as e:
+            raise ConnectorError(
+                f"Cannot convert {payload} to event JSON: missing {e}") from e
+        raise ConnectorError(f"Cannot convert unknown type '{ptype}' to Event JSON.")

@@ -1,0 +1,8 @@
+"""REST servers (L5): event ingest, query serving, admin, dashboard.
+
+Rebuilds the reference's akka-http servers on aiohttp:
+  * EventServer (data/.../api/EventServer.scala) — port 7070
+  * Query server (core/.../workflow/CreateServer.scala) — port 8000
+  * Admin API (tools/.../admin/AdminAPI.scala) — port 7071
+  * Dashboard (tools/.../dashboard/Dashboard.scala) — port 9000
+"""

@@ -1,0 +1,352 @@
+"""Event Server — REST event ingest on port 7070.
+
+Route-for-route parity with the reference EventServer
+(data/.../api/EventServer.scala):
+
+  GET  /                      -> {"status": "alive"}          (:150)
+  POST /events.json           -> 201 {"eventId": id}          (:241)
+  GET  /events.json           -> query with filters           (:274)
+  GET  /events/<id>.json      -> one event                    (:207)
+  DELETE /events/<id>.json    -> {"message": "Found"}         (:224)
+  POST /batch/events.json     -> per-event status list, <=50  (:340)
+  GET  /stats.json            -> ingest counters (--stats)    (:421)
+  GET  /plugins.json          -> plugin registry dump         (:155)
+  POST /webhooks/<name>.json  -> connector-parsed event       (:442)
+  GET  /webhooks/<name>.json  -> connector liveness           (:delegates)
+
+Auth: accessKey query parameter or `Authorization: Basic <key:>` header;
+optional `channel` query parameter (:92-142). Event writes run in a thread
+pool so sqlite never blocks the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from predictionio_tpu.data.event import Event, EventValidationError, parse_event_time, validate_event
+from predictionio_tpu.server.plugins import PluginContext
+from predictionio_tpu.server.stats import Stats
+from predictionio_tpu.storage.base import StorageError
+from predictionio_tpu.storage.registry import Storage
+
+logger = logging.getLogger("pio.eventserver")
+
+#: EventServer.scala:66
+MAX_EVENTS_PER_BATCH = 50
+DEFAULT_PORT = 7070
+
+
+class AuthData:
+    __slots__ = ("app_id", "channel_id", "events")
+
+    def __init__(self, app_id: int, channel_id: Optional[int], events):
+        self.app_id = app_id
+        self.channel_id = channel_id
+        self.events = tuple(events)
+
+
+def _json_response(data, status=200):
+    return web.json_response(data, status=status)
+
+
+class EventServer:
+    def __init__(self, stats: bool = False,
+                 plugin_context: Optional[PluginContext] = None):
+        self.stats_enabled = stats
+        self.stats = Stats()
+        self.plugins = plugin_context or PluginContext(
+            "predictionio_tpu.eventserver_plugins")
+        self.app = web.Application()
+        self._routes()
+
+    # -- auth ---------------------------------------------------------------
+    async def _auth(self, request: web.Request) -> AuthData:
+        """EventServer.scala:92-142 — query param first, then Basic header."""
+        access_key = request.query.get("accessKey")
+        if access_key is None:
+            header = request.headers.get("Authorization", "")
+            if header.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(header[len("Basic "):]).decode()
+                    access_key = decoded.strip().split(":")[0]
+                except Exception:
+                    raise web.HTTPUnauthorized(
+                        text=json.dumps({"message": "Invalid accessKey."}),
+                        content_type="application/json")
+            else:
+                raise web.HTTPUnauthorized(
+                    text=json.dumps({"message": "Missing accessKey."}),
+                    content_type="application/json")
+        key = await self._run(Storage.get_meta_data_access_keys().get, access_key)
+        if key is None:
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"message": "Invalid accessKey."}),
+                content_type="application/json")
+        channel_id = None
+        channel = request.query.get("channel")
+        if channel is not None:
+            channels = await self._run(
+                Storage.get_meta_data_channels().get_by_appid, key.appid)
+            matched = [c for c in channels if c.name == channel]
+            if not matched:
+                raise web.HTTPUnauthorized(
+                    text=json.dumps({"message": f"Invalid channel '{channel}'."}),
+                    content_type="application/json")
+            channel_id = matched[0].id
+        return AuthData(key.appid, channel_id, key.events)
+
+    async def _run(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    # -- routes -------------------------------------------------------------
+    def _routes(self):
+        r = self.app.router
+        r.add_get("/", self.handle_root)
+        r.add_post("/events.json", self.handle_create)
+        r.add_get("/events.json", self.handle_find)
+        r.add_get("/events/{event_id}.json", self.handle_get)
+        r.add_delete("/events/{event_id}.json", self.handle_delete)
+        r.add_post("/batch/events.json", self.handle_batch)
+        r.add_get("/stats.json", self.handle_stats)
+        r.add_get("/plugins.json", self.handle_plugins)
+        r.add_route("*", "/plugins/{tail:.*}", self.handle_plugin_rest)
+        r.add_post("/webhooks/{name}.json", self.handle_webhook_post)
+        r.add_get("/webhooks/{name}.json", self.handle_webhook_get)
+
+    async def handle_root(self, request):
+        return _json_response({"status": "alive"})
+
+    async def handle_create(self, request):
+        auth = await self._auth(request)
+        try:
+            body = await request.json()
+            event = Event.from_dict(body)
+            validate_event(event)
+        except (EventValidationError, json.JSONDecodeError, TypeError,
+                AttributeError, ValueError) as e:
+            return _json_response({"message": str(e)}, status=400)
+        if auth.events and event.event not in auth.events:
+            return _json_response(
+                {"message": f"{event.event} events are not allowed"}, status=403)
+        for blocker in self.plugins.input_blockers.values():
+            try:
+                blocker.process(auth.app_id, auth.channel_id, event)
+            except Exception as e:  # blocker rejected the event
+                return _json_response({"message": str(e)}, status=403)
+        try:
+            event_id = await self._run(
+                Storage.get_events().insert, event, auth.app_id, auth.channel_id)
+        except StorageError as e:
+            return _json_response({"message": str(e)}, status=500)
+        for sniffer in self.plugins.input_sniffers.values():
+            try:
+                sniffer.process(auth.app_id, auth.channel_id, event)
+            except Exception:
+                logger.exception("input sniffer failed")
+        if self.stats_enabled:
+            self.stats.bookkeeping(auth.app_id, 201, event)
+        return _json_response({"eventId": event_id}, status=201)
+
+    async def handle_find(self, request):
+        auth = await self._auth(request)
+        q = request.query
+        try:
+            reversed_order = q.get("reversed", "false").lower() == "true"
+            if reversed_order and not (q.get("entityType") and q.get("entityId")):
+                # EventServer.scala:302-305
+                return _json_response(
+                    {"message": "the parameter reversed can only be used with "
+                                "both entityType and entityId specified."},
+                    status=400)
+            kwargs = dict(
+                start_time=(parse_event_time(q["startTime"])
+                            if "startTime" in q else None),
+                until_time=(parse_event_time(q["untilTime"])
+                            if "untilTime" in q else None),
+                entity_type=q.get("entityType"),
+                entity_id=q.get("entityId"),
+                event_names=[q["event"]] if "event" in q else None,
+                limit=int(q.get("limit", 20)),  # default 20 (:319)
+                reversed_order=reversed_order,
+            )
+            if "targetEntityType" in q:
+                kwargs["target_entity_type"] = q["targetEntityType"]
+            if "targetEntityId" in q:
+                kwargs["target_entity_id"] = q["targetEntityId"]
+        except (EventValidationError, ValueError) as e:
+            return _json_response({"message": str(e)}, status=400)
+
+        def _find():
+            return list(Storage.get_events().find(
+                auth.app_id, auth.channel_id, **kwargs))
+        try:
+            events = await self._run(_find)
+        except StorageError as e:
+            return _json_response({"message": str(e)}, status=500)
+        if not events:
+            return _json_response({"message": "Not Found"}, status=404)
+        return _json_response([e.to_dict() for e in events])
+
+    async def handle_get(self, request):
+        auth = await self._auth(request)
+        event_id = request.match_info["event_id"]
+        try:
+            event = await self._run(
+                Storage.get_events().get, event_id, auth.app_id, auth.channel_id)
+        except StorageError as e:
+            return _json_response({"message": str(e)}, status=500)
+        if event is None:
+            return _json_response({"message": "Not Found"}, status=404)
+        return _json_response(event.to_dict())
+
+    async def handle_delete(self, request):
+        auth = await self._auth(request)
+        event_id = request.match_info["event_id"]
+        try:
+            found = await self._run(
+                Storage.get_events().delete, event_id, auth.app_id, auth.channel_id)
+        except StorageError as e:
+            return _json_response({"message": str(e)}, status=500)
+        if found:
+            return _json_response({"message": "Found"})
+        return _json_response({"message": "Not Found"}, status=404)
+
+    async def handle_batch(self, request):
+        """EventServer.scala:340-419 — per-event results, original order."""
+        auth = await self._auth(request)
+        try:
+            body = await request.json()
+            if not isinstance(body, list):
+                raise ValueError("batch body must be a JSON array")
+        except (json.JSONDecodeError, ValueError) as e:
+            return _json_response({"message": str(e)}, status=400)
+        if len(body) > MAX_EVENTS_PER_BATCH:
+            return _json_response(
+                {"message": "Batch request must have less than or equal to "
+                            f"{MAX_EVENTS_PER_BATCH} events"}, status=400)
+        results = []
+        to_insert = []  # (index, event)
+        for i, item in enumerate(body):
+            try:
+                event = Event.from_dict(item)
+                validate_event(event)
+            except (EventValidationError, TypeError, AttributeError) as e:
+                results.append((i, {"status": 400, "message": str(e)}))
+                continue
+            if auth.events and event.event not in auth.events:
+                results.append((i, {
+                    "status": 403,
+                    "message": f"{event.event} events are not allowed"}))
+                continue
+            blocked = False
+            for blocker in self.plugins.input_blockers.values():
+                try:
+                    blocker.process(auth.app_id, auth.channel_id, event)
+                except Exception as e:
+                    results.append((i, {"status": 403, "message": str(e)}))
+                    blocked = True
+                    break
+            if not blocked:
+                to_insert.append((i, event))
+        if to_insert:
+            try:
+                ids = await self._run(
+                    Storage.get_events().insert_batch,
+                    [e for _, e in to_insert], auth.app_id, auth.channel_id)
+            except StorageError as e:
+                return _json_response({"message": str(e)}, status=500)
+            for (i, event), event_id in zip(to_insert, ids):
+                if self.stats_enabled:
+                    self.stats.bookkeeping(auth.app_id, 201, event)
+                for sniffer in self.plugins.input_sniffers.values():
+                    try:
+                        sniffer.process(auth.app_id, auth.channel_id, event)
+                    except Exception:
+                        logger.exception("input sniffer failed")
+                results.append((i, {"status": 201, "eventId": event_id}))
+        results.sort(key=lambda pair: pair[0])
+        return _json_response([r for _, r in results])
+
+    async def handle_stats(self, request):
+        auth = await self._auth(request)
+        if not self.stats_enabled:
+            return _json_response(
+                {"message": "To see stats, launch Event Server with --stats "
+                            "argument."}, status=404)
+        return _json_response(self.stats.get(auth.app_id))
+
+    async def handle_plugins(self, request):
+        return _json_response({"plugins": self.plugins.describe()})
+
+    async def handle_plugin_rest(self, request):
+        auth = await self._auth(request)
+        segments = request.match_info["tail"].split("/")
+        if len(segments) < 2:
+            return _json_response({"message": "Not Found"}, status=404)
+        plugin_type, plugin_name, *args = segments
+        registry = {"inputblockers": self.plugins.input_blockers,
+                    "inputsniffers": self.plugins.input_sniffers}.get(plugin_type)
+        if registry is None or plugin_name not in registry:
+            return _json_response({"message": "Not Found"}, status=404)
+        out = registry[plugin_name].handle_rest(auth.app_id, auth.channel_id, args)
+        return _json_response(out)
+
+    # -- webhooks (EventServer.scala:442-523) -------------------------------
+    async def handle_webhook_post(self, request):
+        auth = await self._auth(request)
+        name = request.match_info["name"]
+        from predictionio_tpu.data.webhooks import get_connector
+        connector = get_connector(name)
+        if connector is None:
+            return _json_response(
+                {"message": f"webhooks connection for {name} is not supported."},
+                status=404)
+        try:
+            if connector.form_based:
+                payload = dict(await request.post())
+            else:
+                payload = await request.json()
+            event = connector.to_event(payload)
+            validate_event(event)
+        except Exception as e:
+            return _json_response({"message": str(e)}, status=400)
+        try:
+            event_id = await self._run(
+                Storage.get_events().insert, event, auth.app_id, auth.channel_id)
+        except StorageError as e:
+            return _json_response({"message": str(e)}, status=500)
+        if self.stats_enabled:
+            self.stats.bookkeeping(auth.app_id, 201, event)
+        return _json_response({"eventId": event_id}, status=201)
+
+    async def handle_webhook_get(self, request):
+        await self._auth(request)
+        name = request.match_info["name"]
+        from predictionio_tpu.data.webhooks import get_connector
+        connector = get_connector(name)
+        if connector is None:
+            return _json_response(
+                {"message": f"webhooks connection for {name} is not supported."},
+                status=404)
+        return _json_response({"message": f"webhooks connection for {name} is ok."})
+
+
+def create_event_server(stats: bool = False,
+                        plugin_context: Optional[PluginContext] = None
+                        ) -> web.Application:
+    """EventServer.createEventServer:528 parity."""
+    return EventServer(stats=stats, plugin_context=plugin_context).app
+
+
+def run_event_server(ip: str = "localhost", port: int = DEFAULT_PORT,
+                     stats: bool = False) -> None:
+    """Standalone entry (EventServer Run.main:552)."""
+    app = create_event_server(stats=stats)
+    logger.info("Event Server listening on %s:%s", ip, port)
+    web.run_app(app, host=ip, port=port, print=None)

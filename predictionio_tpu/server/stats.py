@@ -1,0 +1,54 @@
+"""Event-server ingest statistics.
+
+Parity with the reference's Stats/StatsActor
+(data/.../api/Stats.scala:28-80, StatsActor.scala:30-76): per-app counters
+keyed by (status, event name, entity type), kept for the current hour and
+for the server's lifetime, surfaced at /stats.json.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from collections import Counter
+from typing import Dict
+
+from predictionio_tpu.data.event import UTC, Event
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hour_start = self._floor_hour(_dt.datetime.now(tz=UTC))
+        self._hourly: Dict[int, Counter] = {}
+        self._longlive: Dict[int, Counter] = {}
+
+    @staticmethod
+    def _floor_hour(t: _dt.datetime) -> _dt.datetime:
+        return t.replace(minute=0, second=0, microsecond=0)
+
+    def bookkeeping(self, app_id: int, status: int, event: Event) -> None:
+        key = (status, event.event, event.entity_type)
+        now = _dt.datetime.now(tz=UTC)
+        with self._lock:
+            hour = self._floor_hour(now)
+            if hour != self._hour_start:  # roll the hourly window
+                self._hour_start = hour
+                self._hourly = {}
+            self._hourly.setdefault(app_id, Counter())[key] += 1
+            self._longlive.setdefault(app_id, Counter())[key] += 1
+
+    def get(self, app_id: int) -> dict:
+        with self._lock:
+            return {
+                "startTime": self._hour_start.isoformat(),
+                "hourly": _render(self._hourly.get(app_id, Counter())),
+                "longLive": _render(self._longlive.get(app_id, Counter())),
+            }
+
+
+def _render(counter: Counter) -> list:
+    return [
+        {"status": status, "event": event, "entityType": etype, "count": count}
+        for (status, event, etype), count in sorted(counter.items())
+    ]

@@ -1,0 +1,34 @@
+"""Pluggable storage layer (L1/L2).
+
+Rebuilds the reference's storage SPI (data/.../storage/Storage.scala:146-466)
+and backends (storage/{jdbc,hbase,elasticsearch,localfs,s3}): metadata stores,
+the event store, and model blob stores, discovered through an env-var driven
+registry. The default backend is sqlite (replacing the reference's JDBC
+default); `memory` serves tests and `localfs` stores model checkpoints.
+"""
+
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    AccessKeys,
+    App,
+    Apps,
+    Channel,
+    Channels,
+    EngineInstance,
+    EngineInstances,
+    EvaluationInstance,
+    EvaluationInstances,
+    EventStore,
+    Model,
+    Models,
+    StorageError,
+    UNFILTERED,
+)
+from predictionio_tpu.storage.registry import Storage
+
+__all__ = [
+    "App", "Apps", "AccessKey", "AccessKeys", "Channel", "Channels",
+    "EngineInstance", "EngineInstances", "EvaluationInstance",
+    "EvaluationInstances", "Model", "Models", "EventStore", "StorageError",
+    "UNFILTERED", "Storage",
+]

@@ -1,0 +1,379 @@
+"""Storage SPI: interfaces every backend implements, plus metadata records.
+
+Parity map (reference file:line):
+  * EventStore      <- LEvents trait (data/.../storage/LEvents.scala:40-513);
+                       the parallel PEvents path (PEvents.scala:38-189) becomes
+                       EventStore.find_columnar -> pyarrow table for training
+  * Apps            <- Apps.scala:32-61
+  * AccessKeys      <- AccessKeys.scala:35-77
+  * Channels        <- Channels.scala:32-82 (name rule :54-57)
+  * EngineInstances <- EngineInstances.scala:46-180
+  * EvaluationInstances <- EvaluationInstances.scala:42-138
+  * Models          <- Models.scala:33-86
+
+The rebuild's API is synchronous; the event server wraps calls in its asyncio
+executor. Instead of Scala's Option[Option[T]] target filters, the sentinel
+UNFILTERED distinguishes "no filter" from "must be absent" (None).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import datetime as _dt
+import re
+import secrets
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.data.aggregator import aggregate_properties as _aggregate
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event, UTC
+
+
+class StorageError(Exception):
+    """Backend-level storage failure (parity with StorageException)."""
+
+
+class _Unfiltered:
+    """Sentinel: this filter is not applied at all."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "UNFILTERED"
+
+
+UNFILTERED = _Unfiltered()
+
+
+def generate_id() -> str:
+    """Random identifier for events/instances (JDBCUtils.generateId parity)."""
+    return uuid.uuid4().hex
+
+
+# ---------------------------------------------------------------------------
+# Metadata records
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class App:
+    """Apps.scala:32 — (id, name, description)."""
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AccessKey:
+    """AccessKeys.scala:35 — (key, appid, allowed event names; [] = all)."""
+    key: str
+    appid: int
+    events: Sequence[str] = ()
+
+
+CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+CHANNEL_NAME_CONSTRAINT = "Only alphanumeric and - characters are allowed and max length is 16."
+
+
+def is_valid_channel_name(name: str) -> bool:
+    """Channels.scala:54-57 — 1-16 alphanumeric or '-' characters."""
+    return bool(CHANNEL_NAME_RE.match(name))
+
+
+@dataclasses.dataclass(frozen=True)
+class Channel:
+    """Channels.scala:32 — (id, name unique within app, appid)."""
+    id: int
+    name: str
+    appid: int
+
+    def __post_init__(self):
+        if not is_valid_channel_name(self.name):
+            raise ValueError(
+                f"Invalid channel name: {self.name}. {CHANNEL_NAME_CONSTRAINT}")
+
+
+def _utcnow() -> _dt.datetime:
+    return _dt.datetime.now(tz=UTC)
+
+
+@dataclasses.dataclass
+class EngineInstance:
+    """EngineInstances.scala:46 — one train run and its deployable artifact.
+
+    `runtime_conf` replaces the reference's sparkConf (jax/XLA settings:
+    mesh shape, precision, compilation flags).
+    """
+    id: str = ""
+    status: str = "INIT"  # INIT -> COMPLETED (failed runs stay INIT)
+    start_time: _dt.datetime = dataclasses.field(default_factory=_utcnow)
+    end_time: _dt.datetime = dataclasses.field(default_factory=_utcnow)
+    engine_id: str = ""
+    engine_version: str = ""
+    engine_variant: str = ""
+    engine_factory: str = ""
+    batch: str = ""
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    runtime_conf: Dict[str, str] = dataclasses.field(default_factory=dict)
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+
+@dataclasses.dataclass
+class EvaluationInstance:
+    """EvaluationInstances.scala:42 — one evaluation run and its results."""
+    id: str = ""
+    status: str = ""
+    start_time: _dt.datetime = dataclasses.field(default_factory=_utcnow)
+    end_time: _dt.datetime = dataclasses.field(default_factory=_utcnow)
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    runtime_conf: Dict[str, str] = dataclasses.field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """Models.scala:33 — serialized model blob keyed by engine instance id."""
+    id: str
+    models: bytes
+
+
+# ---------------------------------------------------------------------------
+# Metadata store interfaces
+# ---------------------------------------------------------------------------
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]:
+        """Insert; generates an id when app.id == 0. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> None: ...
+
+
+class AccessKeys(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, k: AccessKey) -> Optional[str]:
+        """Insert; generates a key when k.key is empty. Returns the key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, k: AccessKey) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> None: ...
+
+    @staticmethod
+    def generate_key() -> str:
+        """Random URL-safe key (AccessKeys.scala:68 parity)."""
+        return secrets.token_urlsafe(48)
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]:
+        """Insert; generates an id when channel.id == 0. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> List[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> None: ...
+
+
+class EngineInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, i: EngineInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self, engine_id: str, engine_version: str,
+                      engine_variant: str) -> List[EngineInstance]:
+        """COMPLETED instances, latest start_time first (EngineInstances.scala:88)."""
+
+    def get_latest_completed(self, engine_id: str, engine_version: str,
+                             engine_variant: str) -> Optional[EngineInstance]:
+        """EngineInstances.scala:82."""
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+    @abc.abstractmethod
+    def update(self, i: EngineInstance) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> None: ...
+
+
+class EvaluationInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, i: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> List[EvaluationInstance]:
+        """EVALCOMPLETED instances, latest start_time first."""
+
+    @abc.abstractmethod
+    def update(self, i: EvaluationInstance) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> None: ...
+
+
+class Models(abc.ABC):
+    """Binary model blob store (Models.scala:33-86)."""
+
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# Event store interface
+# ---------------------------------------------------------------------------
+
+class EventStore(abc.ABC):
+    """Event CRUD + query + aggregation, per (app_id, channel_id) namespace.
+
+    LEvents trait parity (LEvents.scala:40-513). All methods synchronous; the
+    REST layer offloads to a thread pool. `find_columnar` is the training-path
+    analog of PEvents.find, returning a pyarrow.Table.
+    """
+
+    @abc.abstractmethod
+    def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Initialize the namespace (LEvents.init:53)."""
+
+    @abc.abstractmethod
+    def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Remove the namespace and all its events (LEvents.remove:63)."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        """Insert one event, returning its id (LEvents.futureInsert:90)."""
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        """LEvents.futureInsertBatch:106 — override for bulk backends."""
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]: ...
+
+    @abc.abstractmethod
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool: ...
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type=UNFILTERED,
+        target_entity_id=UNFILTERED,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]:
+        """LEvents.futureFind:188 — time range [start, until), optional
+        filters; limit=None -> all, limit=-1 -> all (reference parity);
+        reversed_order returns latest first (only valid with entityType+entityId
+        in the reference; the rebuild allows it everywhere)."""
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """LEvents.futureAggregateProperties:215 — fold special events."""
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=list(_SPECIAL),
+        )
+        out = _aggregate(events)
+        if required:
+            req = list(required)
+            out = {k: v for k, v in out.items()
+                   if all(r in v for r in req)}
+        return out
+
+    def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
+                      **filters):
+        """Training-path read: events as a pyarrow.Table (PEvents.find analog).
+
+        Default implementation materializes through `find`; columnar backends
+        override with a direct scan.
+        """
+        from predictionio_tpu.data.columnar import events_to_table
+        return events_to_table(self.find(app_id, channel_id, **filters))
+
+
+_SPECIAL = ("$set", "$unset", "$delete")

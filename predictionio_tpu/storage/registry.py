@@ -1,0 +1,232 @@
+"""Env-var driven storage registry.
+
+Parity with the reference's Storage object (data/.../storage/Storage.scala:146-466):
+
+  * ``PIO_STORAGE_SOURCES_<NAME>_TYPE``  — backend type of source <NAME>
+    (rebuild types: ``sqlite``, ``localfs``; the reference's jdbc/hbase/
+    elasticsearch/s3/hdfs map onto these or are future backends)
+  * ``PIO_STORAGE_SOURCES_<NAME>_PATH`` — backend-specific location
+  * ``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}``
+    — binds each repository to a source
+
+Clients are created lazily and cached per source name (Storage.getClient:247
+parity). `Storage.configure` provides a programmatic override used by tests
+and embedded use; `Storage.reset` clears the cache.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.base import StorageError
+
+_SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_([A-Z0-9_]+)$")
+_REPO_RE = re.compile(r"^PIO_STORAGE_REPOSITORIES_([^_]+)_(NAME|SOURCE)$")
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+_DEFAULT_HOME = os.path.join(os.path.expanduser("~"), ".pio_tpu")
+
+
+def _parse_env(env: Dict[str, str]) -> Dict:
+    sources: Dict[str, Dict[str, str]] = {}
+    repos: Dict[str, Dict[str, str]] = {}
+    for key, value in env.items():
+        m = _SOURCE_RE.match(key)
+        if m:
+            sources.setdefault(m.group(1), {})[m.group(2)] = value
+            continue
+        m = _REPO_RE.match(key)
+        if m:
+            repos.setdefault(m.group(1), {})[m.group(2)] = value
+    return {"sources": sources, "repositories": repos}
+
+
+def default_config(home: Optional[str] = None) -> Dict:
+    """Single-file sqlite under $PIO_HOME (or ~/.pio_tpu) for everything."""
+    home = home or os.environ.get("PIO_HOME", _DEFAULT_HOME)
+    db = os.path.join(home, "data", "pio.db")
+    return {
+        "sources": {
+            "SQLITE": {"TYPE": "sqlite", "PATH": db},
+            "LOCALFS": {"TYPE": "localfs",
+                        "PATH": os.path.join(home, "models")},
+        },
+        "repositories": {
+            "METADATA": {"NAME": "pio_meta", "SOURCE": "SQLITE"},
+            "EVENTDATA": {"NAME": "pio_event", "SOURCE": "SQLITE"},
+            "MODELDATA": {"NAME": "pio_model", "SOURCE": "LOCALFS"},
+        },
+    }
+
+
+class Storage:
+    """Lazy, cached accessors for all data objects (Storage.scala:401-454)."""
+
+    _lock = threading.Lock()
+    _config: Optional[Dict] = None
+    _clients: Dict[str, object] = {}
+    _objects: Dict[str, object] = {}
+
+    # -- configuration ------------------------------------------------------
+    @classmethod
+    def configure(cls, config: Dict) -> None:
+        """Programmatic configuration; resets all cached clients."""
+        with cls._lock:
+            cls._close_clients()
+            cls._config = config
+
+    @classmethod
+    def configure_memory(cls) -> None:
+        """All repositories on one in-memory sqlite (test/dev convenience)."""
+        cls.configure({
+            "sources": {"MEM": {"TYPE": "sqlite", "PATH": ":memory:"}},
+            "repositories": {
+                r: {"NAME": "pio", "SOURCE": "MEM"} for r in REPOSITORIES},
+        })
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._close_clients()
+            cls._config = None
+
+    @classmethod
+    def _close_clients(cls) -> None:
+        for c in cls._clients.values():
+            close = getattr(c, "close", None)
+            if close:
+                try:
+                    close()
+                except Exception:
+                    pass
+        cls._clients = {}
+        cls._objects = {}
+
+    @classmethod
+    def config(cls) -> Dict:
+        if cls._config is None:
+            parsed = _parse_env(dict(os.environ))
+            if parsed["sources"] and parsed["repositories"]:
+                cls._config = parsed
+            else:
+                cls._config = default_config()
+        return cls._config
+
+    # -- client / object construction ---------------------------------------
+    @classmethod
+    def _source_conf(cls, repository: str) -> Dict[str, str]:
+        conf = cls.config()
+        repo = conf["repositories"].get(repository)
+        if not repo:
+            raise StorageError(f"repository {repository} is not configured")
+        source = conf["sources"].get(repo["SOURCE"])
+        if not source:
+            raise StorageError(
+                f"source {repo['SOURCE']} (for repository {repository}) "
+                "is not configured")
+        return source
+
+    @classmethod
+    def _client(cls, source_name: str):
+        with cls._lock:
+            if source_name in cls._clients:
+                return cls._clients[source_name]
+            conf = cls.config()["sources"][source_name]
+            stype = conf.get("TYPE", "sqlite")
+            if stype == "sqlite":
+                from predictionio_tpu.storage.sqlite_backend import SqliteClient
+                client = SqliteClient(conf.get("PATH", ":memory:"))
+            elif stype == "localfs":
+                client = conf  # localfs needs no client beyond its config
+            else:
+                raise StorageError(f"unknown storage type {stype!r} "
+                                   f"for source {source_name}")
+            cls._clients[source_name] = client
+            return client
+
+    @classmethod
+    def _get(cls, repository: str, kind: str):
+        cache_key = f"{repository}:{kind}"
+        if cache_key in cls._objects:
+            return cls._objects[cache_key]
+        conf = cls.config()
+        repo = conf["repositories"].get(repository)
+        if not repo:
+            raise StorageError(f"repository {repository} is not configured")
+        source_name = repo["SOURCE"]
+        source = cls._source_conf(repository)
+        stype = source.get("TYPE", "sqlite")
+        client = cls._client(source_name)
+        obj = _construct(stype, kind, client, source)
+        cls._objects[cache_key] = obj
+        return obj
+
+    # -- accessors (Storage.scala:401-454 parity) ---------------------------
+    @classmethod
+    def get_meta_data_apps(cls) -> base.Apps:
+        return cls._get("METADATA", "apps")
+
+    @classmethod
+    def get_meta_data_access_keys(cls) -> base.AccessKeys:
+        return cls._get("METADATA", "accesskeys")
+
+    @classmethod
+    def get_meta_data_channels(cls) -> base.Channels:
+        return cls._get("METADATA", "channels")
+
+    @classmethod
+    def get_meta_data_engine_instances(cls) -> base.EngineInstances:
+        return cls._get("METADATA", "engineinstances")
+
+    @classmethod
+    def get_meta_data_evaluation_instances(cls) -> base.EvaluationInstances:
+        return cls._get("METADATA", "evaluationinstances")
+
+    @classmethod
+    def get_model_data_models(cls) -> base.Models:
+        return cls._get("MODELDATA", "models")
+
+    @classmethod
+    def get_events(cls) -> base.EventStore:
+        """The event store (getLEvents/getPEvents unified)."""
+        return cls._get("EVENTDATA", "events")
+
+    @classmethod
+    def verify_all_data_objects(cls) -> bool:
+        """Storage.verifyAllDataObjects:372 — used by `pio status`."""
+        cls.get_meta_data_apps()
+        cls.get_meta_data_access_keys()
+        cls.get_meta_data_channels()
+        cls.get_meta_data_engine_instances()
+        cls.get_meta_data_evaluation_instances()
+        cls.get_model_data_models()
+        events = cls.get_events()
+        events.init_channel(0, None)
+        events.remove_channel(0, None)
+        return True
+
+
+def _construct(stype: str, kind: str, client, source_conf: Dict[str, str]):
+    if stype == "sqlite":
+        from predictionio_tpu.storage import sqlite_backend as sb
+        ctors = {
+            "apps": sb.SqliteApps,
+            "accesskeys": sb.SqliteAccessKeys,
+            "channels": sb.SqliteChannels,
+            "engineinstances": sb.SqliteEngineInstances,
+            "evaluationinstances": sb.SqliteEvaluationInstances,
+            "models": sb.SqliteModels,
+            "events": sb.SqliteEvents,
+        }
+        return ctors[kind](client)
+    if stype == "localfs":
+        if kind != "models":
+            raise StorageError("localfs source only supports MODELDATA")
+        from predictionio_tpu.storage.localfs_models import LocalFSModels
+        return LocalFSModels(source_conf.get("PATH", os.path.join(_DEFAULT_HOME, "models")))
+    raise StorageError(f"unknown storage type {stype!r}")

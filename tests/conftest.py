@@ -17,6 +17,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def anyio_backend():
+    """Async tests (event/query server) run on asyncio via the anyio plugin."""
+    return "asyncio"
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     import jax

@@ -1,0 +1,315 @@
+"""Event Server REST tests.
+
+Mirrors the reference's akka-http testkit spec
+(data/src/test/.../api/EventServiceSpec.scala) and the integration scenario
+tests/pio_tests/scenarios/eventserver_test.py (batch semantics incl.
+partially malformed payloads).
+"""
+
+import base64
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+pytestmark = pytest.mark.anyio
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.server.event_server import create_event_server
+from predictionio_tpu.server.plugins import EventServerPlugin, PluginContext
+from predictionio_tpu.storage import AccessKey, App, Channel, Storage
+
+
+@pytest.fixture()
+def backend(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "es.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="testapp"))
+    Storage.get_events().init_channel(app_id)
+    key = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key="", appid=app_id, events=()))
+    restricted = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key="", appid=app_id, events=("view",)))
+    cid = Storage.get_meta_data_channels().insert(
+        Channel(id=0, name="ch1", appid=app_id))
+    Storage.get_events().init_channel(app_id, cid)
+    yield {"app_id": app_id, "key": key, "restricted": restricted}
+    Storage.reset()
+
+
+@pytest.fixture()
+async def client(backend):
+    app = create_event_server(stats=True)
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    yield c, backend
+    await c.close()
+
+
+EV = {"event": "view", "entityType": "user", "entityId": "u1",
+      "targetEntityType": "item", "targetEntityId": "i1"}
+
+
+async def test_root_alive(client):
+    c, _ = client
+    resp = await c.get("/")
+    assert resp.status == 200
+    assert (await resp.json()) == {"status": "alive"}
+
+
+async def test_create_and_get_event(client):
+    c, b = client
+    resp = await c.post(f"/events.json?accessKey={b['key']}", json=EV)
+    assert resp.status == 201
+    event_id = (await resp.json())["eventId"]
+    assert event_id
+    resp = await c.get(f"/events/{event_id}.json?accessKey={b['key']}")
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["event"] == "view"
+    assert body["entityId"] == "u1"
+    assert body["targetEntityId"] == "i1"
+
+
+async def test_auth_missing_and_invalid(client):
+    c, _ = client
+    assert (await c.post("/events.json", json=EV)).status == 401
+    assert (await c.post("/events.json?accessKey=WRONG", json=EV)).status == 401
+
+
+async def test_auth_basic_header(client):
+    c, b = client
+    token = base64.b64encode(f"{b['key']}:".encode()).decode()
+    resp = await c.post("/events.json", json=EV,
+                        headers={"Authorization": f"Basic {token}"})
+    assert resp.status == 201
+
+
+async def test_restricted_key_forbids_event(client):
+    c, b = client
+    ok = dict(EV)
+    resp = await c.post(f"/events.json?accessKey={b['restricted']}", json=ok)
+    assert resp.status == 201
+    bad = dict(EV, event="buy")
+    resp = await c.post(f"/events.json?accessKey={b['restricted']}", json=bad)
+    assert resp.status == 403
+    assert "not allowed" in (await resp.json())["message"]
+
+
+async def test_invalid_event_rejected(client):
+    c, b = client
+    resp = await c.post(f"/events.json?accessKey={b['key']}",
+                        json={"event": "$set", "entityType": "user"})
+    assert resp.status == 400
+    resp = await c.post(f"/events.json?accessKey={b['key']}",
+                        json={"event": "pio_bad", "entityType": "user",
+                              "entityId": "u1"})
+    assert resp.status == 400
+
+
+async def test_find_events(client):
+    c, b = client
+    for i in range(3):
+        ev = dict(EV, entityId=f"u{i}",
+                  eventTime=f"2024-01-0{i + 1}T00:00:00Z")
+        assert (await c.post(f"/events.json?accessKey={b['key']}",
+                             json=ev)).status == 201
+    resp = await c.get(f"/events.json?accessKey={b['key']}")
+    assert resp.status == 200
+    assert len(await resp.json()) == 3
+    # filters
+    resp = await c.get(f"/events.json?accessKey={b['key']}&entityId=u1")
+    assert len(await resp.json()) == 1
+    resp = await c.get(
+        f"/events.json?accessKey={b['key']}&startTime=2024-01-02T00:00:00Z")
+    assert len(await resp.json()) == 2
+    resp = await c.get(f"/events.json?accessKey={b['key']}&limit=2")
+    assert len(await resp.json()) == 2
+    # no match -> 404 (EventServer.scala:330)
+    resp = await c.get(f"/events.json?accessKey={b['key']}&entityId=zzz")
+    assert resp.status == 404
+    # reversed requires entityType+entityId (:302)
+    resp = await c.get(f"/events.json?accessKey={b['key']}&reversed=true")
+    assert resp.status == 400
+    resp = await c.get(f"/events.json?accessKey={b['key']}"
+                       "&entityType=user&entityId=u1&reversed=true")
+    assert resp.status == 200
+
+
+async def test_delete_event(client):
+    c, b = client
+    resp = await c.post(f"/events.json?accessKey={b['key']}", json=EV)
+    event_id = (await resp.json())["eventId"]
+    resp = await c.delete(f"/events/{event_id}.json?accessKey={b['key']}")
+    assert resp.status == 200
+    assert (await resp.json()) == {"message": "Found"}
+    resp = await c.delete(f"/events/{event_id}.json?accessKey={b['key']}")
+    assert resp.status == 404
+
+
+async def test_channel_isolation(client):
+    c, b = client
+    resp = await c.post(f"/events.json?accessKey={b['key']}&channel=ch1",
+                        json=EV)
+    assert resp.status == 201
+    # default channel does not see it
+    resp = await c.get(f"/events.json?accessKey={b['key']}")
+    assert resp.status == 404
+    resp = await c.get(f"/events.json?accessKey={b['key']}&channel=ch1")
+    assert len(await resp.json()) == 1
+    # invalid channel name -> 401
+    resp = await c.post(f"/events.json?accessKey={b['key']}&channel=nope",
+                        json=EV)
+    assert resp.status == 401
+
+
+async def test_batch_partially_malformed(client):
+    """Batch returns per-event status preserving order (EventServer.scala:340-419)."""
+    c, b = client
+    batch = [
+        dict(EV, entityId="ok1"),
+        {"event": "view", "entityType": "user"},     # malformed: no entityId
+        dict(EV, entityId="ok2"),
+    ]
+    resp = await c.post(f"/batch/events.json?accessKey={b['key']}", json=batch)
+    assert resp.status == 200
+    results = await resp.json()
+    assert [r["status"] for r in results] == [201, 400, 201]
+    assert "eventId" in results[0] and "eventId" in results[2]
+    assert "message" in results[1]
+
+
+async def test_batch_forbidden_event_status(client):
+    c, b = client
+    batch = [dict(EV), dict(EV, event="buy")]
+    resp = await c.post(f"/batch/events.json?accessKey={b['restricted']}",
+                        json=batch)
+    results = await resp.json()
+    assert [r["status"] for r in results] == [201, 403]
+
+
+async def test_batch_too_large(client):
+    c, b = client
+    batch = [dict(EV, entityId=f"u{i}") for i in range(51)]
+    resp = await c.post(f"/batch/events.json?accessKey={b['key']}", json=batch)
+    assert resp.status == 400
+    assert "50" in (await resp.json())["message"]
+
+
+async def test_stats(client):
+    c, b = client
+    await c.post(f"/events.json?accessKey={b['key']}", json=EV)
+    resp = await c.get(f"/stats.json?accessKey={b['key']}")
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["longLive"][0]["count"] == 1
+    assert body["longLive"][0]["event"] == "view"
+
+
+async def test_stats_disabled(backend):
+    app = create_event_server(stats=False)
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    try:
+        resp = await c.get(f"/stats.json?accessKey={backend['key']}")
+        assert resp.status == 404
+    finally:
+        await c.close()
+
+
+async def test_plugins_json(client):
+    c, _ = client
+    resp = await c.get("/plugins.json")
+    assert resp.status == 200
+    assert "plugins" in await resp.json()
+
+
+async def test_input_blocker_rejects(backend):
+    class Blocker(EventServerPlugin):
+        plugin_name = "strict"
+        plugin_type = EventServerPlugin.INPUT_BLOCKER
+
+        def process(self, app_id, channel_id, event):
+            if event.entity_id == "blocked":
+                raise ValueError("blocked entity")
+
+    ctx = PluginContext()
+    ctx.register(Blocker())
+    app = create_event_server(plugin_context=ctx)
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    try:
+        ok = await c.post(f"/events.json?accessKey={backend['key']}", json=EV)
+        assert ok.status == 201
+        resp = await c.post(f"/events.json?accessKey={backend['key']}",
+                            json=dict(EV, entityId="blocked"))
+        assert resp.status == 403
+    finally:
+        await c.close()
+
+
+async def test_webhook_json(client):
+    c, b = client
+    payload = {
+        "type": "userAction", "userId": "as34smg4", "event": "do_something",
+        "context": {"ip": "24.5.68.47", "prop1": 2.345, "prop2": "value1"},
+        "anotherProperty1": 100, "anotherProperty2": "optional1",
+        "timestamp": "2015-01-02T00:30:12.984Z",
+    }
+    resp = await c.post(f"/webhooks/examplejson.json?accessKey={b['key']}",
+                        json=payload)
+    assert resp.status == 201
+    # liveness
+    resp = await c.get(f"/webhooks/examplejson.json?accessKey={b['key']}")
+    assert resp.status == 200
+    # unknown connector
+    resp = await c.post(f"/webhooks/unknown.json?accessKey={b['key']}",
+                        json={})
+    assert resp.status == 404
+
+
+async def test_webhook_segmentio(client):
+    c, b = client
+    payload = {
+        "version": "2", "type": "track", "userId": "u42",
+        "event": "Signed Up", "timestamp": "2015-01-02T00:30:12.984Z",
+        "properties": {"plan": "pro"}, "sent_at": "2015-01-02T00:30:12.984Z",
+    }
+    resp = await c.post(f"/webhooks/segmentio.json?accessKey={b['key']}",
+                        json=payload)
+    assert resp.status == 201
+    event_id = (await resp.json())["eventId"]
+    resp = await c.get(f"/events/{event_id}.json?accessKey={b['key']}")
+    body = await resp.json()
+    assert body["event"] == "track"
+    assert body["entityId"] == "u42"
+    assert body["properties"]["event"] == "Signed Up"
+
+
+async def test_webhook_mailchimp_form(client):
+    c, b = client
+    form = {
+        "type": "subscribe", "fired_at": "2009-03-26 21:35:57",
+        "data[id]": "8a25ff1d98", "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com", "data[email_type]": "html",
+        "data[merges][EMAIL]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp", "data[merges][LNAME]": "API",
+        "data[ip_opt]": "10.20.10.30", "data[ip_signup]": "10.20.10.30",
+    }
+    resp = await c.post(f"/webhooks/mailchimp.json?accessKey={b['key']}",
+                        data=form)
+    assert resp.status == 201
+    event_id = (await resp.json())["eventId"]
+    resp = await c.get(f"/events/{event_id}.json?accessKey={b['key']}")
+    body = await resp.json()
+    assert body["event"] == "subscribe"
+    assert body["entityId"] == "8a25ff1d98"
+    assert body["targetEntityId"] == "a6b5da1054"
+    assert body["properties"]["merges"]["FNAME"] == "MailChimp"
+    assert body["eventTime"].startswith("2009-03-26T21:35:57")

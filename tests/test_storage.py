@@ -1,0 +1,300 @@
+"""Storage backend contract tests.
+
+The analog of the reference's shared behavioral spec run against every
+backend (storage/jdbc/src/test/.../{LEventsSpec,PEventsSpec}.scala:
+"init default / insert 3 and get back / find / aggregate / channels /
+remove"), plus metadata store CRUD.
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.storage import (
+    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
+    Storage, StorageError, UNFILTERED,
+)
+from predictionio_tpu.storage.sqlite_backend import SqliteClient, SqliteEvents
+
+UTC = dt.timezone.utc
+T0 = dt.datetime(2024, 1, 1, tzinfo=UTC)
+
+
+def t(days):
+    return T0 + dt.timedelta(days=days)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    """Parametrized over backends as more land; sqlite-file for now."""
+    client = SqliteClient(str(tmp_path / "events.db"))
+    s = SqliteEvents(client)
+    s.init_channel(1)
+    yield s
+    s.close()
+
+
+def ev(i, name="view", etype="user", eid="u1", **kw):
+    base = dict(event=name, entity_type=etype, entity_id=eid,
+                event_time=t(i), creation_time=t(i))
+    base.update(kw)
+    return Event(**base)
+
+
+# -- event store contract ----------------------------------------------------
+
+def test_insert_and_get_back(store):
+    events = [ev(0), ev(1, eid="u2"), ev(2, name="buy")]
+    ids = store.insert_batch(events, 1)
+    assert len(set(ids)) == 3
+    for eid, orig in zip(ids, events):
+        got = store.get(eid, 1)
+        assert got is not None
+        assert got.event == orig.event
+        assert got.entity_id == orig.entity_id
+        assert got.event_time == orig.event_time
+
+
+def test_get_missing_returns_none(store):
+    assert store.get("nonexistent", 1) is None
+
+
+def test_delete(store):
+    eid = store.insert(ev(0), 1)
+    assert store.delete(eid, 1) is True
+    assert store.get(eid, 1) is None
+    assert store.delete(eid, 1) is False
+
+
+def test_find_filters(store):
+    store.insert_batch([
+        ev(0, "view", eid="u1"),
+        ev(1, "buy", eid="u1"),
+        ev(2, "view", eid="u2", etype="customer"),
+        ev(3, "view", eid="u1",
+           target_entity_type="item", target_entity_id="i1"),
+    ], 1)
+    assert len(list(store.find(1))) == 4
+    assert len(list(store.find(1, event_names=["view"]))) == 3
+    assert len(list(store.find(1, entity_type="user"))) == 3
+    assert len(list(store.find(1, entity_id="u2"))) == 1
+    assert len(list(store.find(1, start_time=t(1)))) == 3
+    assert len(list(store.find(1, until_time=t(1)))) == 1
+    assert len(list(store.find(1, start_time=t(1), until_time=t(3)))) == 2
+    assert len(list(store.find(1, limit=2))) == 2
+    # target filters: UNFILTERED vs None vs value
+    assert len(list(store.find(1, target_entity_type=None))) == 3
+    assert len(list(store.find(1, target_entity_type="item"))) == 1
+    assert len(list(store.find(1, target_entity_id="i1"))) == 1
+
+
+def test_find_ordering(store):
+    store.insert_batch([ev(2), ev(0), ev(1)], 1)
+    times = [e.event_time for e in store.find(1)]
+    assert times == sorted(times)
+    rev = [e.event_time for e in store.find(1, reversed_order=True)]
+    assert rev == sorted(times, reverse=True)
+
+
+def test_properties_round_trip(store):
+    e = ev(0, properties=DataMap({"a": 1, "nested": {"x": [1, 2]}}),
+           tags=("t1", "t2"), pr_id="pr9")
+    eid = store.insert(e, 1)
+    got = store.get(eid, 1)
+    assert got.properties == DataMap({"a": 1, "nested": {"x": [1, 2]}})
+    assert got.tags == ("t1", "t2")
+    assert got.pr_id == "pr9"
+
+
+def test_aggregate_properties(store):
+    store.insert_batch([
+        ev(0, "$set", eid="u1", properties=DataMap({"a": 1, "b": 2})),
+        ev(1, "$set", eid="u1", properties=DataMap({"a": 3})),
+        ev(2, "$unset", eid="u1", properties=DataMap({"b": None})),
+        ev(0, "$set", eid="u2", properties=DataMap({"c": 9})),
+        ev(1, "$delete", eid="u2"),
+        ev(0, "$set", eid="i1", etype="item", properties=DataMap({"p": 1})),
+    ], 1)
+    out = store.aggregate_properties(1, "user")
+    assert set(out) == {"u1"}
+    assert out["u1"].fields == {"a": 3}
+    items = store.aggregate_properties(1, "item")
+    assert set(items) == {"i1"}
+
+
+def test_aggregate_required_filter(store):
+    store.insert_batch([
+        ev(0, "$set", eid="u1", properties=DataMap({"a": 1})),
+        ev(0, "$set", eid="u2", properties=DataMap({"a": 1, "b": 2})),
+    ], 1)
+    out = store.aggregate_properties(1, "user", required=["b"])
+    assert set(out) == {"u2"}
+
+
+def test_channels_isolated(store):
+    store.init_channel(1, channel_id=7)
+    store.insert(ev(0), 1)
+    store.insert(ev(1), 1, channel_id=7)
+    assert len(list(store.find(1))) == 1
+    assert len(list(store.find(1, channel_id=7))) == 1
+    store.remove_channel(1, channel_id=7)
+    with pytest.raises(StorageError):
+        list(store.find(1, channel_id=7))
+
+
+def test_insert_into_missing_app_raises(store):
+    with pytest.raises(StorageError):
+        store.insert(ev(0), 999)
+
+
+def test_find_columnar(store):
+    store.insert_batch([
+        ev(0, "rate", eid="u1", target_entity_type="item",
+           target_entity_id="i1", properties=DataMap({"rating": 4.0})),
+        ev(1, "rate", eid="u2", target_entity_type="item",
+           target_entity_id="i2", properties=DataMap({"rating": 2.5})),
+    ], 1)
+    table = store.find_columnar(1, event_names=["rate"])
+    assert table.num_rows == 2
+    from predictionio_tpu.data.columnar import ratings_arrays
+    users, items, ratings = ratings_arrays(table)
+    assert list(users) == ["u1", "u2"]
+    assert list(items) == ["i1", "i2"]
+    assert list(ratings) == [4.0, 2.5]
+
+
+# -- metadata stores ---------------------------------------------------------
+
+@pytest.fixture()
+def meta(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "meta.db")},
+                    "FS": {"TYPE": "localfs", "PATH": str(tmp_path / "models")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "FS"},
+        },
+    })
+    yield Storage
+    Storage.reset()
+
+
+def test_apps_crud(meta):
+    apps = meta.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="myapp", description="d"))
+    assert app_id is not None
+    assert apps.get(app_id).name == "myapp"
+    assert apps.get_by_name("myapp").id == app_id
+    # duplicate name rejected
+    assert apps.insert(App(id=0, name="myapp")) is None
+    apps.update(App(id=app_id, name="renamed"))
+    assert apps.get_by_name("renamed") is not None
+    assert len(apps.get_all()) == 1
+    apps.delete(app_id)
+    assert apps.get(app_id) is None
+
+
+def test_access_keys_crud(meta):
+    keys = meta.get_meta_data_access_keys()
+    k = keys.insert(AccessKey(key="", appid=1, events=("view", "buy")))
+    assert k  # generated
+    got = keys.get(k)
+    assert got.appid == 1
+    assert got.events == ("view", "buy")
+    assert keys.get_by_appid(1) == [got]
+    assert keys.get_by_appid(2) == []
+    keys.update(AccessKey(key=k, appid=2))
+    assert keys.get(k).appid == 2
+    assert keys.get(k).events == ()
+    keys.delete(k)
+    assert keys.get(k) is None
+
+
+def test_channels_crud(meta):
+    channels = meta.get_meta_data_channels()
+    cid = channels.insert(Channel(id=0, name="ch1", appid=1))
+    assert channels.get(cid).name == "ch1"
+    assert len(channels.get_by_appid(1)) == 1
+    # duplicate (name, app) rejected; same name other app ok
+    assert channels.insert(Channel(id=0, name="ch1", appid=1)) is None
+    assert channels.insert(Channel(id=0, name="ch1", appid=2)) is not None
+    channels.delete(cid)
+    assert channels.get(cid) is None
+    with pytest.raises(ValueError):
+        Channel(id=0, name="bad name!", appid=1)
+    with pytest.raises(ValueError):
+        Channel(id=0, name="x" * 17, appid=1)
+
+
+def test_engine_instances_crud(meta):
+    eis = meta.get_meta_data_engine_instances()
+    i = EngineInstance(engine_id="e1", engine_version="1", engine_variant="v",
+                       engine_factory="f", env={"K": "V"},
+                       algorithms_params='[{"name":"als"}]')
+    iid = eis.insert(i)
+    got = eis.get(iid)
+    assert got.status == "INIT"
+    assert got.env == {"K": "V"}
+    assert eis.get_latest_completed("e1", "1", "v") is None
+    got.status = "COMPLETED"
+    eis.update(got)
+    assert eis.get_latest_completed("e1", "1", "v").id == iid
+    # a later COMPLETED run wins
+    j = EngineInstance(engine_id="e1", engine_version="1", engine_variant="v",
+                       status="COMPLETED",
+                       start_time=got.start_time + dt.timedelta(hours=1))
+    jid = eis.insert(j)
+    assert eis.get_latest_completed("e1", "1", "v").id == jid
+    eis.delete(iid)
+    assert eis.get(iid) is None
+
+
+def test_evaluation_instances_crud(meta):
+    evis = meta.get_meta_data_evaluation_instances()
+    iid = evis.insert(EvaluationInstance(evaluation_class="MyEval"))
+    got = evis.get(iid)
+    assert got.evaluation_class == "MyEval"
+    assert evis.get_completed() == []
+    got.status = "EVALCOMPLETED"
+    got.evaluator_results = "metric=0.5"
+    evis.update(got)
+    assert len(evis.get_completed()) == 1
+    evis.delete(iid)
+    assert evis.get(iid) is None
+
+
+def test_models_blob_store(meta):
+    models = meta.get_model_data_models()
+    blob = b"\x00\x01binary\xff"
+    models.insert(Model(id="inst1", models=blob))
+    assert models.get("inst1").models == blob
+    # overwrite allowed
+    models.insert(Model(id="inst1", models=b"v2"))
+    assert models.get("inst1").models == b"v2"
+    models.delete("inst1")
+    assert models.get("inst1") is None
+    assert models.get("missing") is None
+
+
+def test_verify_all_data_objects(meta):
+    assert meta.verify_all_data_objects() is True
+
+
+def test_event_store_facade(meta):
+    from predictionio_tpu.data.eventstore import EventStoreClient, clear_cache
+    clear_cache()
+    apps = meta.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="facade-app"))
+    events = meta.get_events()
+    events.init_channel(app_id)
+    events.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                        properties=DataMap({"x": 1}), event_time=T0), app_id)
+    found = list(EventStoreClient.find("facade-app", entity_type="user"))
+    assert len(found) == 1
+    props = EventStoreClient.aggregate_properties("facade-app", "user")
+    assert props["u1"].fields == {"x": 1}
+    with pytest.raises(StorageError):
+        list(EventStoreClient.find("nonexistent-app"))
+    clear_cache()

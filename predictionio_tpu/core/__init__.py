@@ -1,0 +1,50 @@
+"""DASE controller API (L3).
+
+Rebuilds the reference's core/controller + core/core
+(SURVEY.md sections 2.4-2.5) as plain Python protocols over JAX: DataSource ->
+Preparator -> Algorithm(s) -> Serving, plus Evaluation/Metric. Where the
+reference splits L/P/P2L class families by Spark physical placement
+(LAlgorithm.scala / P2LAlgorithm.scala / PAlgorithm.scala), the rebuild has
+ONE protocol per component: "local" is simply a mesh of one device, and every
+model is a pytree, making serialization uniform (SURVEY.md section 7 design
+mapping).
+"""
+
+from predictionio_tpu.core.base import (
+    Algorithm,
+    DataSource,
+    Preparator,
+    IdentityPreparator,
+    SanityCheck,
+    Serving,
+    FirstServing,
+    AverageServing,
+    PersistentModel,
+)
+from predictionio_tpu.core.params import EngineParams, Params, params_to_json, params_from_json
+from predictionio_tpu.core.engine import Engine, EngineFactory, TrainResult
+from predictionio_tpu.core.metrics import (
+    Metric,
+    AverageMetric,
+    OptionAverageMetric,
+    StdevMetric,
+    OptionStdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.core.evaluation import (
+    Evaluation,
+    EngineParamsGenerator,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+
+__all__ = [
+    "Algorithm", "DataSource", "Preparator", "IdentityPreparator",
+    "SanityCheck", "Serving", "FirstServing", "AverageServing",
+    "PersistentModel", "EngineParams", "Params", "params_to_json",
+    "params_from_json", "Engine", "EngineFactory", "TrainResult", "Metric",
+    "AverageMetric", "OptionAverageMetric", "StdevMetric", "OptionStdevMetric",
+    "SumMetric", "ZeroMetric", "Evaluation", "EngineParamsGenerator",
+    "MetricEvaluator", "MetricEvaluatorResult",
+]

@@ -1,0 +1,228 @@
+"""DASE component protocols.
+
+Parity map (reference file:line):
+  * DataSource  <- BaseDataSource (core/.../core/BaseDataSource.scala:34-55),
+    PDataSource/LDataSource (controller/{PDataSource.scala:37,LDataSource.scala:38})
+  * Preparator  <- BasePreparator.scala:33-45, PPreparator/LPreparator
+  * Algorithm   <- BaseAlgorithm.scala:58-126 unifying LAlgorithm.scala:45,
+    P2LAlgorithm.scala:46, PAlgorithm.scala:47 — one protocol; models are
+    pytrees, "local vs distributed" is a property of the mesh, not the class
+  * Serving     <- BaseServing.scala:31-54, LServing.scala:30
+  * SanityCheck <- core/.../core/SanityCheck.scala:27-33
+  * PersistentModel(+loader) <- controller/PersistentModel.scala:67-103
+
+Component constructors take their params object (or nothing) — the Doer
+convention (core/.../core/AbstractDoer.scala:29-69) resolved by signature
+inspection instead of JVM reflection.
+"""
+
+from __future__ import annotations
+
+import abc
+import inspect
+from typing import (Any, Generic, List, Optional, Sequence, Tuple, TypeVar)
+
+TD = TypeVar("TD")   # training data
+EI = TypeVar("EI")   # evaluation info
+PD = TypeVar("PD")   # prepared data
+Q = TypeVar("Q")     # query
+P = TypeVar("P")     # prediction
+A = TypeVar("A")     # actual
+M = TypeVar("M")     # model
+
+
+class SanityCheck(abc.ABC):
+    """Data classes may self-validate during training (SanityCheck.scala:27)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None:
+        """Raise if the data is invalid."""
+
+
+def instantiate(cls: type, params: Any):
+    """Doer.apply parity (AbstractDoer.scala:29-69): construct with the params
+    object when the constructor accepts one, else no-arg. When no params were
+    configured (None), a no-arg constructor is preferred — matching the
+    reference's fallback to the zero-argument constructor."""
+    try:
+        sig = inspect.signature(cls.__init__)
+        positional = [
+            p for name, p in sig.parameters.items()
+            if name not in ("self",) and p.kind in (
+                inspect.Parameter.POSITIONAL_ONLY,
+                inspect.Parameter.POSITIONAL_OR_KEYWORD)
+        ]
+    except (TypeError, ValueError):
+        positional = []
+    no_arg_ok = all(p.default is not inspect.Parameter.empty
+                    for p in positional)
+    if positional and not (params is None and no_arg_ok):
+        return cls(params)
+    return cls()
+
+
+def params_class_of(cls: type) -> Optional[type]:
+    """The component's declared params dataclass, if any.
+
+    Resolution order: an explicit `params_class` attribute, then the type
+    annotation of the constructor's first parameter.
+    """
+    explicit = getattr(cls, "params_class", None)
+    if explicit is not None:
+        return explicit
+    try:
+        import dataclasses
+        import typing
+
+        hints = typing.get_type_hints(cls.__init__)
+        sig = inspect.signature(cls.__init__)
+    except (TypeError, ValueError, NameError):
+        return None
+    for name, p in sig.parameters.items():
+        if name == "self":
+            continue
+        ann = hints.get(name)
+        # only a declared params type counts: a dataclass or Params subclass
+        # (primitive annotations like `int = 0` are construction defaults)
+        from predictionio_tpu.core.params import Params as _Params
+
+        if isinstance(ann, type) and (dataclasses.is_dataclass(ann)
+                                      or issubclass(ann, _Params)):
+            return ann
+        return None
+    return None
+
+
+class DataSource(Generic[TD, EI, Q, A], abc.ABC):
+    """Reads training and evaluation data from the event store."""
+
+    @abc.abstractmethod
+    def read_training(self, ctx) -> TD:
+        """BaseDataSource.readTrainingBase (BaseDataSource.scala:43)."""
+
+    def read_eval(self, ctx) -> Sequence[Tuple[TD, EI, Sequence[Tuple[Q, A]]]]:
+        """K folds of (training data, eval info, (query, actual) pairs)
+        (BaseDataSource.readEvalBase:55). Default: no eval data."""
+        return []
+
+
+class Preparator(Generic[TD, PD], abc.ABC):
+    @abc.abstractmethod
+    def prepare(self, ctx, training_data: TD) -> PD:
+        """BasePreparator.prepareBase (BasePreparator.scala:42)."""
+
+
+class IdentityPreparator(Preparator):
+    """controller/IdentityPreparator.scala:32."""
+
+    def prepare(self, ctx, training_data):
+        return training_data
+
+
+class Algorithm(Generic[PD, M, Q, P], abc.ABC):
+    """One algorithm: train on the mesh, predict at serving time.
+
+    The model M must be a picklable object; pytrees of (device or numpy)
+    arrays are the norm and are converted to numpy at checkpoint time.
+    """
+
+    @abc.abstractmethod
+    def train(self, ctx, prepared_data: PD) -> M:
+        """BaseAlgorithm.trainBase (BaseAlgorithm.scala:69)."""
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P:
+        """Single-query predict (BaseAlgorithm.predictBase:93)."""
+
+    def batch_predict(self, model: M, queries: Sequence[Tuple[int, Q]]
+                      ) -> List[Tuple[int, P]]:
+        """Indexed batch predict for eval/batch scoring
+        (BaseAlgorithm.batchPredictBase:81). Override with a vmap'd/jitted
+        implementation where shapes allow."""
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+    def make_persistent_model(self, ctx, model_id: str, algo_params: Any,
+                              model: M) -> Any:
+        """BaseAlgorithm.makePersistentModel:111 — return value semantics:
+          * the model object itself (default): checkpoint it in the model store
+          * a PersistentModelManifest: the algorithm saved it itself
+            (PersistentModel contract)
+          * None: do not persist; retrain at deploy (PAlgorithm.scala:112
+            default behavior)
+        """
+        if isinstance(model, PersistentModel):
+            if model.save(model_id, algo_params, ctx):
+                return PersistentModelManifest(_class_path(type(model)))
+            return None
+        return model
+
+
+class Serving(Generic[Q, P], abc.ABC):
+    def supplement(self, query: Q) -> Q:
+        """BaseServing.supplementBase (BaseServing.scala:39)."""
+        return query
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        """Combine per-algorithm predictions (BaseServing.serveBase:54)."""
+
+
+class FirstServing(Serving):
+    """controller/LFirstServing.scala:28."""
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """controller/LAverageServing.scala:28 — numeric mean of predictions."""
+
+    def serve(self, query, predictions):
+        return sum(predictions) / len(predictions)
+
+
+class PersistentModel(abc.ABC):
+    """Custom model persistence contract (PersistentModel.scala:67-103).
+
+    Models implementing this save themselves (e.g. to an orbax checkpoint
+    dir) and are reloaded through their class `load` method at deploy.
+    """
+
+    @abc.abstractmethod
+    def save(self, model_id: str, params: Any, ctx) -> bool:
+        """Return True if saved; False falls back to retrain-on-deploy."""
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, model_id: str, params: Any, ctx) -> "PersistentModel":
+        """PersistentModelLoader.apply parity."""
+
+
+class PersistentModelManifest:
+    """Stored in place of the model when custom persistence is used
+    (core/.../workflow/PersistentModelManifest.scala:21)."""
+
+    def __init__(self, class_path: str):
+        self.class_path = class_path
+
+    def __repr__(self):
+        return f"PersistentModelManifest({self.class_path})"
+
+
+def _class_path(cls: type) -> str:
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def load_class(path: str) -> type:
+    """Resolve 'module.sub:Class' or 'module.sub.Class' to a class object."""
+    import importlib
+
+    if ":" in path:
+        module_name, qualname = path.split(":", 1)
+    else:
+        module_name, _, qualname = path.rpartition(".")
+    module = importlib.import_module(module_name)
+    obj = module
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj

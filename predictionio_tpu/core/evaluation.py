@@ -1,0 +1,212 @@
+"""Evaluation workflow: metric sweeps over engine params.
+
+Parity map (reference file:line):
+  * Evaluation            <- controller/Evaluation.scala:34-125
+  * EngineParamsGenerator <- controller/EngineParamsGenerator.scala:30-46
+  * MetricEvaluator       <- controller/MetricEvaluator.scala:185-263
+    (evaluateBase:218, best selection:246-249, best.json:252)
+  * prefix-memoized sweep <- controller/FastEvalEngine.scala:46-346 —
+    rebuilt as CachedEvalRunner: datasource / preparator / per-algorithm
+    train results are cached by params-JSON prefix across the sweep, the
+    compilation-cache analog of FastEvalEngine's pipeline memoization
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.core.engine import Engine, evaluate_fold
+from predictionio_tpu.core.metrics import Metric
+from predictionio_tpu.core.params import EngineParams, params_to_json
+
+logger = logging.getLogger("pio.evaluation")
+
+
+class EngineParamsGenerator:
+    """Supplies the list of EngineParams to sweep (EngineParamsGenerator.scala:30)."""
+
+    engine_params_list: Sequence[EngineParams] = ()
+
+
+class Evaluation:
+    """Glue object tying an Engine to a Metric (Evaluation.scala:34).
+
+    Subclass or instantiate with engine + metric (+ other_metrics). Setting
+    `engine_metric` wraps the metric in a MetricEvaluator that also writes
+    best.json (Evaluation.engineMetric_= sugar, :91-99).
+    """
+
+    def __init__(self, engine: Optional[Engine] = None,
+                 metric: Optional[Metric] = None,
+                 other_metrics: Sequence[Metric] = (),
+                 output_path: Optional[str] = "best.json"):
+        self.engine = engine
+        self.metric = metric
+        self.other_metrics = list(other_metrics)
+        self.output_path = output_path
+
+    @property
+    def evaluator(self) -> "MetricEvaluator":
+        return MetricEvaluator(self.metric, self.other_metrics,
+                               self.output_path)
+
+    def run(self, ctx, engine_params_list: Sequence[EngineParams]
+            ) -> "MetricEvaluatorResult":
+        return self.evaluator.evaluate(ctx, self.engine, engine_params_list)
+
+
+@dataclasses.dataclass
+class MetricEvaluatorResult:
+    """MetricEvaluator.scala:64-110 — scores per params with the best pick."""
+
+    best_score: float
+    best_engine_params: EngineParams
+    best_idx: int
+    metric_header: str
+    other_metric_headers: List[str]
+    engine_params_scores: List[Tuple[EngineParams, float, List[float]]]
+
+    def to_one_liner(self) -> str:
+        return f"[{self.metric_header}] {self.best_score}"
+
+    def to_json_dict(self) -> dict:
+        return {
+            "bestScore": self.best_score,
+            "bestEngineParams": self.best_engine_params.to_json_dict(),
+            "bestIdx": self.best_idx,
+            "metricHeader": self.metric_header,
+            "otherMetricHeaders": self.other_metric_headers,
+            "engineParamsScores": [
+                {"engineParams": ep.to_json_dict(), "score": s, "others": o}
+                for ep, s, o in self.engine_params_scores],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_dict(), sort_keys=True)
+
+    def to_html(self) -> str:
+        rows = "".join(
+            f"<tr><td>{i}</td><td>{s}</td><td><pre>{ep.to_json()}</pre></td></tr>"
+            for i, (ep, s, _o) in enumerate(self.engine_params_scores))
+        return (f"<html><body><h1>{self.metric_header}</h1>"
+                f"<p>Best score: {self.best_score} "
+                f"(params #{self.best_idx})</p>"
+                f"<table border=1><tr><th>#</th><th>score</th>"
+                f"<th>engine params</th></tr>{rows}</table></body></html>")
+
+
+class CachedEvalRunner:
+    """FastEvalEngine.scala:46-346 rebuilt: memoize shared pipeline prefixes.
+
+    Within one sweep, engine params sharing a prefix reuse results:
+      * data source (read_eval folds) keyed by datasource params
+      * prepared data keyed by (datasource, preparator) params
+      * trained models keyed by (datasource, preparator, single algo params)
+    Jitted train functions additionally hit XLA's compilation cache when only
+    numeric hyperparameters change.
+    """
+
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self._ds_cache: Dict[str, Any] = {}
+        self._prep_cache: Dict[str, Any] = {}
+        self._model_cache: Dict[str, Any] = {}
+
+    @staticmethod
+    def _key(*parts: Any) -> str:
+        return json.dumps([_jsonable(p) for p in parts], sort_keys=True,
+                          default=str)
+
+    def eval(self, ctx, ep: EngineParams):
+        ds_key = self._key(ep.data_source_name, ep.data_source_params)
+        if ds_key not in self._ds_cache:
+            data_source = self.engine._data_source(ep)
+            self._ds_cache[ds_key] = list(data_source.read_eval(ctx))
+        eval_data = self._ds_cache[ds_key]
+
+        prep_key = self._key(ds_key, ep.preparator_name, ep.preparator_params)
+        if prep_key not in self._prep_cache:
+            preparator = self.engine._preparator(ep)
+            self._prep_cache[prep_key] = [
+                preparator.prepare(ctx, td) for td, _ei, _qa in eval_data]
+        prepared = self._prep_cache[prep_key]
+
+        named_algos = self.engine._algorithms(ep)
+        serving = self.engine._serving(ep)
+
+        results = []
+        for fold_idx, ((td, eval_info, qa_pairs), pd) in enumerate(
+                zip(eval_data, prepared)):
+            models = []
+            for (name, algo), (pname, algo_params) in zip(
+                    named_algos, ep.algorithm_params_list):
+                model_key = self._key(prep_key, fold_idx, pname, algo_params)
+                if model_key not in self._model_cache:
+                    self._model_cache[model_key] = algo.train(ctx, pd)
+                models.append(self._model_cache[model_key])
+            qpa = evaluate_fold(named_algos, models, serving, qa_pairs)
+            results.append((eval_info, qpa))
+        return results
+
+
+def _jsonable(p: Any) -> Any:
+    try:
+        return params_to_json(p)
+    except TypeError:
+        return repr(p)
+
+
+class MetricEvaluator:
+    """MetricEvaluator.scala:185 — score every engine params, pick the best."""
+
+    def __init__(self, metric: Metric, other_metrics: Sequence[Metric] = (),
+                 output_path: Optional[str] = "best.json"):
+        self.metric = metric
+        self.other_metrics = list(other_metrics)
+        self.output_path = output_path
+
+    def evaluate(self, ctx, engine: Engine,
+                 engine_params_list: Sequence[EngineParams]
+                 ) -> MetricEvaluatorResult:
+        if not engine_params_list:
+            raise ValueError("engine_params_list must not be empty")
+        runner = CachedEvalRunner(engine)
+        scores: List[Tuple[EngineParams, float, List[float]]] = []
+        for i, ep in enumerate(engine_params_list):
+            eval_data = runner.eval(ctx, ep)
+            score = self.metric.calculate(ctx, eval_data)
+            others = [m.calculate(ctx, eval_data) for m in self.other_metrics]
+            logger.info("engine params %d/%d: %s = %s",
+                        i + 1, len(engine_params_list),
+                        self.metric.header(), score)
+            scores.append((ep, score, others))
+
+        best_idx = 0
+        for i in range(1, len(scores)):
+            if self.metric.compare(scores[i][1], scores[best_idx][1]) > 0:
+                best_idx = i
+        best_ep, best_score, _ = scores[best_idx]
+        result = MetricEvaluatorResult(
+            best_score=best_score,
+            best_engine_params=best_ep,
+            best_idx=best_idx,
+            metric_header=self.metric.header(),
+            other_metric_headers=[m.header() for m in self.other_metrics],
+            engine_params_scores=scores)
+        if self.output_path:
+            self._save_best_json(best_ep)
+        return result
+
+    def _save_best_json(self, ep: EngineParams) -> None:
+        """MetricEvaluator.saveEngineJson:193 — the deployable best variant."""
+        try:
+            with open(self.output_path, "w") as f:
+                json.dump(ep.to_json_dict(), f, indent=2, sort_keys=True)
+            logger.info("best engine params written to %s",
+                        os.path.abspath(self.output_path))
+        except OSError as e:
+            logger.warning("cannot write %s: %s", self.output_path, e)

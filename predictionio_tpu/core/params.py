@@ -1,0 +1,139 @@
+"""Component parameters and their JSON round-trip.
+
+Parity with the reference's Params/EngineParams
+(core/.../controller/{Params.scala:26-34,EngineParams.scala:35-152}) and the
+JSON extraction in Engine.jValueToEngineParams (Engine.scala:355-418) /
+JsonExtractor (core/.../workflow/JsonExtractor.scala:37-167). The reference
+needs a dual json4s/Gson stack to cover Scala and Java engines; the rebuild
+uses dataclasses, so one extractor suffices.
+
+Engine variant JSON keeps the reference's engine.json schema:
+
+    {
+      "id": "default",
+      "engineFactory": "mypkg.engine:factory",
+      "datasource": {"params": {...}},
+      "preparator": {"params": {...}},
+      "algorithms": [{"name": "als", "params": {...}}],
+      "serving": {"params": {...}}
+    }
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+
+class Params:
+    """Marker base for component params (Params.scala:26). Subclasses are
+    normally dataclasses; plain dicts are also accepted anywhere Params are."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    pass
+
+
+def params_to_json(params: Any) -> Any:
+    """Params (dataclass | dict | None) -> JSON value."""
+    if params is None:
+        return {}
+    if dataclasses.is_dataclass(params) and not isinstance(params, type):
+        return dataclasses.asdict(params)
+    if isinstance(params, dict):
+        return params
+    raise TypeError(f"cannot serialize params of type {type(params).__name__}")
+
+
+def params_from_json(data: Any, params_class: Optional[type] = None) -> Any:
+    """JSON value -> params_class instance (or plain dict when no class).
+
+    Unknown keys raise (the reference's json4s extract is strict in the same
+    way for missing fields; strictness here catches typo'd hyperparameters).
+    """
+    if data is None:
+        data = {}
+    if params_class is None:
+        return dict(data)
+    if not dataclasses.is_dataclass(params_class):
+        return params_class(**data)
+    field_names = {f.name for f in dataclasses.fields(params_class)}
+    unknown = set(data) - field_names
+    if unknown:
+        raise ValueError(
+            f"unknown parameter(s) {sorted(unknown)} for "
+            f"{params_class.__name__}; expected among {sorted(field_names)}")
+    return params_class(**data)
+
+
+@dataclasses.dataclass
+class EngineParams:
+    """EngineParams.scala:35 — named params for each DASE component.
+
+    Component names select among an Engine's registered classes; "" selects
+    the single/default one.
+    """
+
+    data_source_name: str = ""
+    data_source_params: Any = None
+    preparator_name: str = ""
+    preparator_params: Any = None
+    #: list of (algorithm name, params)
+    algorithm_params_list: Sequence[Tuple[str, Any]] = ()
+    serving_name: str = ""
+    serving_params: Any = None
+
+    def to_json_dict(self) -> Dict[str, Any]:
+        """engineParamsToJson parity (JsonExtractor.scala:95)."""
+        return {
+            "datasource": {"name": self.data_source_name,
+                           "params": params_to_json(self.data_source_params)},
+            "preparator": {"name": self.preparator_name,
+                           "params": params_to_json(self.preparator_params)},
+            "algorithms": [
+                {"name": name, "params": params_to_json(p)}
+                for name, p in self.algorithm_params_list],
+            "serving": {"name": self.serving_name,
+                        "params": params_to_json(self.serving_params)},
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_dict(), sort_keys=True)
+
+    def copy(self, **updates) -> "EngineParams":
+        return dataclasses.replace(self, **updates)
+
+
+def engine_params_from_json(
+    data: Dict[str, Any],
+    data_source_params_class: Optional[type] = None,
+    preparator_params_class: Optional[type] = None,
+    algorithm_params_classes: Optional[Dict[str, type]] = None,
+    serving_params_class: Optional[type] = None,
+) -> EngineParams:
+    """jValueToEngineParams parity (Engine.scala:355-418)."""
+    def _component(key: str, cls: Optional[type]):
+        node = data.get(key) or {}
+        if not isinstance(node, dict):
+            raise ValueError(f"{key} must be an object")
+        name = node.get("name", "")
+        params = params_from_json(node.get("params"), cls)
+        return name, params
+
+    ds_name, ds_params = _component("datasource", data_source_params_class)
+    p_name, p_params = _component("preparator", preparator_params_class)
+    s_name, s_params = _component("serving", serving_params_class)
+
+    algo_list: List[Tuple[str, Any]] = []
+    for node in data.get("algorithms") or []:
+        name = node.get("name", "")
+        cls = (algorithm_params_classes or {}).get(name)
+        algo_list.append((name, params_from_json(node.get("params"), cls)))
+
+    return EngineParams(
+        data_source_name=ds_name, data_source_params=ds_params,
+        preparator_name=p_name, preparator_params=p_params,
+        algorithm_params_list=algo_list,
+        serving_name=s_name, serving_params=s_params)

@@ -1,0 +1,12 @@
+"""Workflow engine (L4): train / eval / deploy drivers over a device mesh.
+
+Rebuilds core/workflow (SURVEY.md section 2.6). The reference's
+WorkflowContext creates the one SparkContext; here it creates the one
+`jax.sharding.Mesh` (single-controller JAX replaces the Spark driver).
+"""
+
+from predictionio_tpu.workflow.context import WorkflowContext, WorkflowParams
+from predictionio_tpu.workflow.train import run_train
+from predictionio_tpu.workflow.evaluate import run_evaluation
+
+__all__ = ["WorkflowContext", "WorkflowParams", "run_train", "run_evaluation"]

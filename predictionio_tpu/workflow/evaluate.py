@@ -1,0 +1,59 @@
+"""The evaluation workflow: run a sweep, record EvaluationInstance.
+
+Parity with CoreWorkflow.runEvaluation (core/.../workflow/CoreWorkflow.scala:104-165)
+and EvaluationWorkflow.scala:32-45: insert EvaluationInstance, run the
+evaluation (MetricEvaluator over the params list), store results in oneliner /
+HTML / JSON forms, mark EVALCOMPLETED.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+from typing import Optional, Sequence
+
+from predictionio_tpu.core.evaluation import Evaluation, MetricEvaluatorResult
+from predictionio_tpu.core.params import EngineParams
+from predictionio_tpu.data.event import UTC
+from predictionio_tpu.storage.base import EvaluationInstance
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.context import WorkflowContext, WorkflowParams
+
+logger = logging.getLogger("pio.workflow")
+
+
+def run_evaluation(evaluation: Evaluation,
+                   engine_params_list: Sequence[EngineParams],
+                   evaluation_class: str = "",
+                   params_generator_class: str = "",
+                   workflow_params: Optional[WorkflowParams] = None,
+                   ctx: Optional[WorkflowContext] = None
+                   ) -> MetricEvaluatorResult:
+    wp = workflow_params or WorkflowParams()
+    ctx = ctx or WorkflowContext.create(
+        mode="Evaluation", batch=wp.batch, workflow_params=wp)
+
+    instances = Storage.get_meta_data_evaluation_instances()
+    instance = EvaluationInstance(
+        status="INIT",
+        start_time=_dt.datetime.now(tz=UTC),
+        evaluation_class=evaluation_class or type(evaluation).__name__,
+        engine_params_generator_class=params_generator_class,
+        batch=wp.batch,
+        runtime_conf={k: str(v) for k, v in wp.runtime_conf.items()},
+    )
+    instance_id = instances.insert(instance)
+    instance.id = instance_id
+    logger.info("EvaluationInstance %s created (INIT)", instance_id)
+
+    result = evaluation.run(ctx, engine_params_list)
+
+    instance.status = "EVALCOMPLETED"
+    instance.end_time = _dt.datetime.now(tz=UTC)
+    instance.evaluator_results = result.to_one_liner()
+    instance.evaluator_results_html = result.to_html()
+    instance.evaluator_results_json = result.to_json()
+    instances.update(instance)
+    logger.info("evaluation completed: instance %s — %s",
+                instance_id, result.to_one_liner())
+    return result

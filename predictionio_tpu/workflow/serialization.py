@@ -1,0 +1,51 @@
+"""Model blob (de)serialization.
+
+Replaces the reference's Kryo/chill model blob machinery
+(core/.../workflow/CoreWorkflow.scala:76-81, CreateServer.scala:62-76): every
+model is a picklable Python object; pytrees of jax Arrays are converted to
+numpy first so blobs are host-portable and loadable without devices.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List
+
+
+class _RetrainSentinel:
+    """Marks an algorithm slot whose model is retrained at deploy
+    (the reference's Unit model, PAlgorithm.scala:112)."""
+
+    def __repr__(self):
+        return "RETRAIN_ON_DEPLOY"
+
+
+RETRAIN_ON_DEPLOY = _RetrainSentinel()
+
+
+def _to_host(obj: Any) -> Any:
+    """Pull any jax arrays in a pytree down to numpy."""
+    try:
+        import jax
+
+        leaves, treedef = jax.tree.flatten(obj)
+        if any(isinstance(x, jax.Array) for x in leaves):
+            return jax.tree.unflatten(
+                treedef, [jax.device_get(x) if isinstance(x, jax.Array) else x
+                          for x in leaves])
+    except (ImportError, TypeError):
+        pass
+    return obj
+
+
+def serialize_models(models: List[Any]) -> bytes:
+    payload = [RETRAIN_ON_DEPLOY if m is None else _to_host(m) for m in models]
+    buf = io.BytesIO()
+    pickle.dump(payload, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def deserialize_models(blob: bytes) -> List[Any]:
+    models = pickle.loads(blob)
+    return [None if isinstance(m, _RetrainSentinel) else m for m in models]

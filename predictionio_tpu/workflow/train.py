@@ -1,0 +1,111 @@
+"""The train workflow: run an engine's pipeline, checkpoint, record metadata.
+
+Parity with CoreWorkflow.runTrain (core/.../workflow/CoreWorkflow.scala:45-102)
+and the CreateWorkflow entry (CreateWorkflow.scala:136-281): an EngineInstance
+row is inserted with status INIT, the engine trains on the workflow context's
+mesh, models are serialized into the Models store keyed by the instance id,
+and the instance is marked COMPLETED. Failed runs leave the instance INIT so
+it can never be deployed (SURVEY.md section 5 failure semantics).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+from typing import Optional
+
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.params import EngineParams, params_to_json
+from predictionio_tpu.data.event import UTC
+from predictionio_tpu.storage.base import EngineInstance, Model
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.context import WorkflowContext, WorkflowParams
+from predictionio_tpu.workflow.serialization import serialize_models
+
+logger = logging.getLogger("pio.workflow")
+
+
+def run_train(engine: Engine,
+              engine_params: EngineParams,
+              engine_factory: str = "",
+              engine_variant: str = "default",
+              workflow_params: Optional[WorkflowParams] = None,
+              ctx: Optional[WorkflowContext] = None) -> EngineInstance:
+    """Returns the COMPLETED EngineInstance (raises on failure)."""
+    wp = workflow_params or WorkflowParams()
+    ctx = ctx or WorkflowContext.create(
+        mode="Training", batch=wp.batch, workflow_params=wp)
+
+    instances = Storage.get_meta_data_engine_instances()
+    instance = EngineInstance(
+        status="INIT",
+        start_time=_dt.datetime.now(tz=UTC),
+        engine_id=engine_factory or type(engine).__name__,
+        engine_version="1",
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=wp.batch,
+        runtime_conf={k: str(v) for k, v in wp.runtime_conf.items()},
+        data_source_params=json.dumps(
+            params_to_json(engine_params.data_source_params), sort_keys=True),
+        preparator_params=json.dumps(
+            params_to_json(engine_params.preparator_params), sort_keys=True),
+        algorithms_params=json.dumps(
+            [{"name": n, "params": params_to_json(p)}
+             for n, p in engine_params.algorithm_params_list], sort_keys=True),
+        serving_params=json.dumps(
+            params_to_json(engine_params.serving_params), sort_keys=True),
+    )
+    instance_id = instances.insert(instance)
+    instance.id = instance_id  # insert returns the generated id; don't rely
+    # on the backend mutating the record in place
+    logger.info("EngineInstance %s created (INIT)", instance_id)
+
+    # CoreWorkflow.runTrain:45 — train, persist, mark COMPLETED
+    result = engine.train(
+        ctx, engine_params,
+        skip_sanity_check=wp.skip_sanity_check,
+        stop_after_read=wp.stop_after_read,
+        stop_after_prepare=wp.stop_after_prepare)
+
+    if wp.save_model:
+        persisted = engine.persist_models(ctx, instance_id, result)
+        blob = serialize_models(persisted)
+        Storage.get_model_data_models().insert(
+            Model(id=instance_id, models=blob))
+        logger.info("models saved (%d bytes) for instance %s",
+                    len(blob), instance_id)
+
+    instance.status = "COMPLETED"
+    instance.end_time = _dt.datetime.now(tz=UTC)
+    instances.update(instance)
+    logger.info("training completed: instance %s", instance_id)
+    return instance
+
+
+def load_for_deploy(engine: Engine, instance: EngineInstance,
+                    ctx: Optional[WorkflowContext] = None):
+    """Restore a TrainResult for serving from a COMPLETED instance
+    (CreateServer.scala:204-206 + Engine.prepareDeploy:198)."""
+    from predictionio_tpu.workflow.serialization import deserialize_models
+
+    ctx = ctx or WorkflowContext.create(mode="Serving", batch=instance.batch)
+    engine_params = engine_params_of_instance(engine, instance)
+    model = Storage.get_model_data_models().get(instance.id)
+    persisted = deserialize_models(model.models) if model else \
+        [None] * len(engine_params.algorithm_params_list)
+    return engine.prepare_deploy(ctx, engine_params, instance.id, persisted), ctx
+
+
+def engine_params_of_instance(engine: Engine,
+                              instance: EngineInstance) -> EngineParams:
+    """EngineInstance params JSON -> EngineParams
+    (Engine.engineInstanceToEngineParams:420 parity)."""
+    data = {
+        "datasource": {"params": json.loads(instance.data_source_params or "{}")},
+        "preparator": {"params": json.loads(instance.preparator_params or "{}")},
+        "algorithms": json.loads(instance.algorithms_params or "[]"),
+        "serving": {"params": json.loads(instance.serving_params or "{}")},
+    }
+    return engine.engine_params_from_json(data)

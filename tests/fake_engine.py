@@ -1,0 +1,189 @@
+"""Fake engine zoo for core regression tests.
+
+The Python analog of the reference's SampleEngine
+(core/src/test/scala/.../controller/SampleEngine.scala): components with
+deterministic integer ids so pipeline wiring is assertable; TrainingData
+implements SanityCheck with an error flag to exercise failure paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.core.base import (
+    Algorithm, DataSource, Preparator, SanityCheck, Serving,
+)
+from predictionio_tpu.core.params import Params
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    id: int
+    error: bool = False
+
+    def sanity_check(self):
+        assert not self.error, "Not Error"
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalInfo:
+    id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessedData:
+    id: int
+    td: TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    id: int
+    ex: int = 0
+    qx: int = 0
+    supp: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Actual:
+    id: int
+    ex: int = 0
+    qx: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    id: int
+    q: Query
+    models: Any = None
+    ps: Tuple["Prediction", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    id: int
+    pd: ProcessedData
+
+
+# -- data sources ------------------------------------------------------------
+
+class DataSource0(DataSource):
+    def __init__(self, id: int = 0):
+        self.id = id if isinstance(id, int) else id.get("id", 0)
+
+    def read_training(self, ctx) -> TrainingData:
+        return TrainingData(self.id)
+
+
+@dataclasses.dataclass
+class DataSource1Params(Params):
+    id: int
+    en: int = 0
+    qn: int = 0
+
+
+class DataSource1(DataSource):
+    """readEval yields `en` folds of `qn` (query, actual) pairs."""
+
+    params_class = DataSource1Params
+
+    def __init__(self, params: DataSource1Params):
+        self.params = params
+
+    def read_training(self, ctx) -> TrainingData:
+        return TrainingData(self.params.id)
+
+    def read_eval(self, ctx):
+        out = []
+        for ex in range(self.params.en):
+            qa = [(Query(self.params.id, ex=ex, qx=qx),
+                   Actual(self.params.id, ex=ex, qx=qx))
+                  for qx in range(self.params.qn)]
+            out.append((TrainingData(self.params.id),
+                        EvalInfo(self.params.id), qa))
+        return out
+
+
+class FailingDataSource(DataSource):
+    """PDataSource3 parity: training data that fails its sanity check."""
+
+    def __init__(self, params=None):
+        self.error = True
+
+    def read_training(self, ctx) -> TrainingData:
+        return TrainingData(id=0, error=self.error)
+
+
+# -- preparators -------------------------------------------------------------
+
+class Preparator0(Preparator):
+    def __init__(self, id: int = 0):
+        self.id = id if isinstance(id, int) else (id or {}).get("id", 0)
+
+    def prepare(self, ctx, td: TrainingData) -> ProcessedData:
+        return ProcessedData(self.id, td)
+
+
+# -- algorithms --------------------------------------------------------------
+
+@dataclasses.dataclass
+class AlgoParams(Params):
+    id: int = 0
+
+
+class Algo0(Algorithm):
+    params_class = AlgoParams
+
+    def __init__(self, params: Optional[AlgoParams] = None):
+        self.id = params.id if params else 0
+
+    def train(self, ctx, pd: ProcessedData) -> Model:
+        return Model(self.id, pd)
+
+    def predict(self, model: Model, query: Query) -> Prediction:
+        return Prediction(id=self.id, q=query, models=model)
+
+
+class Algo1(Algo0):
+    def __init__(self, params: Optional[AlgoParams] = None):
+        super().__init__(params)
+        self.id = (params.id if params else 0) + 1
+
+
+class BatchCountingAlgo(Algo0):
+    """Counts batch_predict calls to assert the eval path uses batching."""
+
+    def __init__(self, params: Optional[AlgoParams] = None):
+        super().__init__(params)
+        self.batch_calls = 0
+
+    def batch_predict(self, model, queries):
+        self.batch_calls += 1
+        return super().batch_predict(model, queries)
+
+
+# -- servings ----------------------------------------------------------------
+
+class Serving0(Serving):
+    def __init__(self, id: int = 0):
+        self.id = id if isinstance(id, int) else (id or {}).get("id", 0)
+
+    def serve(self, query: Query, predictions: Sequence[Prediction]
+              ) -> Prediction:
+        return predictions[0]
+
+
+class SupplementServing(Serving):
+    """LServing2 parity: supplement marks the query; serve asserts it."""
+
+    def __init__(self, params=None):
+        pass
+
+    def supplement(self, query: Query) -> Query:
+        return dataclasses.replace(query, supp=True)
+
+    def serve(self, query: Query, predictions: Sequence[Prediction]):
+        for p in predictions:
+            assert p.q.supp, "serving must see supplemented queries"
+        return Prediction(id=-1, q=query, ps=tuple(predictions))

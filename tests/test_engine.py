@@ -1,0 +1,165 @@
+"""Engine train/eval pipeline wiring (mirrors reference EngineTest/
+EngineWorkflowTest driven by the SampleEngine zoo)."""
+
+import dataclasses
+
+import pytest
+
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.engine import (
+    StopAfterPrepareInterruption, StopAfterReadInterruption,
+)
+from fake_engine import (
+    Actual, Algo0, Algo1, AlgoParams, BatchCountingAlgo, DataSource0,
+    DataSource1, DataSource1Params, FailingDataSource, Model, Prediction,
+    Preparator0, ProcessedData, Query, Serving0, SupplementServing,
+    TrainingData,
+)
+
+
+@pytest.fixture()
+def ctx():
+    class FakeCtx:  # train/eval wiring needs no devices
+        pass
+    return FakeCtx()
+
+
+def simple_engine(algo_classes=None):
+    return Engine(
+        data_source_classes=DataSource0,
+        preparator_classes=Preparator0,
+        algorithm_classes=algo_classes or {"algo0": Algo0, "algo1": Algo1},
+        serving_classes=Serving0,
+    )
+
+
+def test_train_single_algo(ctx):
+    engine = simple_engine()
+    ep = EngineParams(algorithm_params_list=[("algo0", AlgoParams(id=3))])
+    result = engine.train(ctx, ep)
+    assert len(result.models) == 1
+    m = result.models[0]
+    assert m == Model(3, ProcessedData(0, TrainingData(0)))
+
+
+def test_train_multi_algo_order(ctx):
+    engine = simple_engine()
+    ep = EngineParams(algorithm_params_list=[
+        ("algo0", AlgoParams(id=1)),
+        ("algo1", AlgoParams(id=10)),
+        ("algo0", AlgoParams(id=2)),
+    ])
+    result = engine.train(ctx, ep)
+    assert [m.id for m in result.models] == [1, 11, 2]
+
+
+def test_train_unknown_algo_name(ctx):
+    engine = simple_engine()
+    ep = EngineParams(algorithm_params_list=[("nope", AlgoParams())])
+    with pytest.raises(KeyError):
+        engine.train(ctx, ep)
+
+
+def test_train_empty_algo_list(ctx):
+    engine = simple_engine()
+    with pytest.raises(ValueError):
+        engine.train(ctx, EngineParams())
+
+
+def test_sanity_check_failure(ctx):
+    engine = Engine(FailingDataSource, Preparator0, {"a": Algo0}, Serving0)
+    ep = EngineParams(algorithm_params_list=[("a", AlgoParams())])
+    with pytest.raises(AssertionError):
+        engine.train(ctx, ep)
+    # skipping sanity check trains fine
+    result = engine.train(ctx, ep, skip_sanity_check=True)
+    assert result.models[0].pd.td.error is True
+
+
+def test_stop_after_read_and_prepare(ctx):
+    engine = simple_engine()
+    ep = EngineParams(algorithm_params_list=[("algo0", AlgoParams())])
+    with pytest.raises(StopAfterReadInterruption) as ei:
+        engine.train(ctx, ep, stop_after_read=True)
+    assert ei.value.training_data == TrainingData(0)
+    with pytest.raises(StopAfterPrepareInterruption) as ei:
+        engine.train(ctx, ep, stop_after_prepare=True)
+    assert ei.value.prepared_data == ProcessedData(0, TrainingData(0))
+
+
+def test_eval_matrix(ctx):
+    """2 folds x 3 queries x 2 algos, predictions aligned per query."""
+    engine = Engine(
+        DataSource1, Preparator0,
+        {"algo0": Algo0, "algo1": Algo1}, SupplementServing)
+    ep = EngineParams(
+        data_source_params=DataSource1Params(id=5, en=2, qn=3),
+        algorithm_params_list=[("algo0", AlgoParams(id=1)),
+                               ("algo1", AlgoParams(id=20))])
+    folds = engine.eval(ctx, ep)
+    assert len(folds) == 2
+    for fold_idx, (eval_info, qpa) in enumerate(folds):
+        assert eval_info.id == 5
+        assert len(qpa) == 3
+        for q, p, a in qpa:
+            assert isinstance(q, Query) and isinstance(a, Actual)
+            assert q.ex == fold_idx
+            assert q.id == 5 and a.id == 5
+            # serving combined both algo predictions, in order
+            assert [pp.id for pp in p.ps] == [1, 21]
+            # each algo saw the supplemented query
+            assert all(pp.q.supp for pp in p.ps)
+            # query/actual alignment: supplement didn't shuffle indices
+            assert p.ps[0].q.qx == a.qx
+
+
+def test_eval_uses_batch_predict(ctx):
+    algo = BatchCountingAlgo(AlgoParams(id=0))
+    engine = Engine(DataSource1, Preparator0, {"a": lambda p=None: algo},
+                    Serving0)
+    ep = EngineParams(
+        data_source_params=DataSource1Params(id=1, en=2, qn=4),
+        algorithm_params_list=[("a", None)])
+    engine.eval(ctx, ep)
+    assert algo.batch_calls == 2  # one batched call per fold
+
+
+def test_engine_params_from_json(ctx):
+    engine = Engine(
+        DataSource1, Preparator0, {"algo0": Algo0}, Serving0)
+    data = {
+        "datasource": {"params": {"id": 9, "en": 1, "qn": 2}},
+        "algorithms": [{"name": "algo0", "params": {"id": 4}}],
+    }
+    ep = engine.engine_params_from_json(data)
+    assert ep.data_source_params == DataSource1Params(id=9, en=1, qn=2)
+    assert ep.algorithm_params_list[0] == ("algo0", AlgoParams(id=4))
+    # typo'd hyperparameter rejected
+    with pytest.raises(ValueError):
+        engine.engine_params_from_json(
+            {"datasource": {"params": {"idd": 9}},
+             "algorithms": [{"name": "algo0", "params": {}}]})
+
+
+def test_prepare_deploy_with_checkpointed_models(ctx):
+    engine = simple_engine()
+    ep = EngineParams(algorithm_params_list=[("algo0", AlgoParams(id=7))])
+    result = engine.train(ctx, ep)
+    persisted = engine.persist_models(ctx, "inst-1", result)
+    assert persisted == result.models  # plain models persist as themselves
+    deployed = engine.prepare_deploy(ctx, ep, "inst-1", persisted)
+    assert deployed.models == result.models
+
+
+def test_prepare_deploy_retrains_none(ctx):
+    class NoPersistAlgo(Algo0):
+        def make_persistent_model(self, ctx, model_id, algo_params, model):
+            return None  # PAlgorithm default: retrain at deploy
+
+    engine = Engine(DataSource0, Preparator0, {"a": NoPersistAlgo}, Serving0)
+    ep = EngineParams(algorithm_params_list=[("a", AlgoParams(id=2))])
+    result = engine.train(ctx, ep)
+    persisted = engine.persist_models(ctx, "inst-2", result)
+    assert persisted == [None]
+    deployed = engine.prepare_deploy(ctx, ep, "inst-2", persisted)
+    assert deployed.models[0] == result.models[0]  # retrained to same model

@@ -1,0 +1,169 @@
+"""Metrics and MetricEvaluator (mirrors reference MetricTest/
+MetricEvaluatorTest/FastEvalEngineTest coverage)."""
+
+import pytest
+
+from predictionio_tpu.core import (
+    AverageMetric, Engine, EngineParams, Evaluation, MetricEvaluator,
+    OptionAverageMetric, StdevMetric, SumMetric, ZeroMetric,
+)
+from predictionio_tpu.core.evaluation import CachedEvalRunner
+from fake_engine import (
+    Algo0, AlgoParams, DataSource1, DataSource1Params, Preparator0, Serving0,
+)
+
+
+class Ctx:
+    pass
+
+
+def eval_data(points):
+    """[(EvalInfo, [(Q,P,A)])] with P carrying the point score."""
+    return [(None, [(None, p, None) for p in points])]
+
+
+class PredictionScore(AverageMetric):
+    def calculate_point(self, eval_info, q, p, a):
+        return p
+
+
+class OptionalScore(OptionAverageMetric):
+    def calculate_point(self, eval_info, q, p, a):
+        return p  # None points are skipped
+
+
+class SumScore(SumMetric):
+    def calculate_point(self, eval_info, q, p, a):
+        return p
+
+
+class StdevScore(StdevMetric):
+    def calculate_point(self, eval_info, q, p, a):
+        return p
+
+
+def test_average_metric():
+    assert PredictionScore().calculate(Ctx(), eval_data([1, 2, 3, 6])) == 3.0
+
+
+def test_option_average_skips_none():
+    assert OptionalScore().calculate(Ctx(), eval_data([1, None, 5])) == 3.0
+
+
+def test_sum_metric():
+    assert SumScore().calculate(Ctx(), eval_data([1, 2, 3])) == 6.0
+
+
+def test_stdev_metric():
+    assert StdevScore().calculate(Ctx(), eval_data([2, 2, 2])) == 0.0
+    assert StdevScore().calculate(Ctx(), eval_data([1, 3])) == 1.0
+
+
+def test_zero_metric():
+    assert ZeroMetric().calculate(Ctx(), eval_data([9, 9])) == 0.0
+
+
+def test_compare_direction():
+    m = PredictionScore()
+    assert m.compare(2.0, 1.0) > 0
+    m.smaller_is_better = True
+    assert m.compare(2.0, 1.0) < 0
+
+
+# -- MetricEvaluator over a real engine sweep --------------------------------
+
+class IdScore(AverageMetric):
+    """Score = the algorithm id carried through Prediction."""
+
+    def calculate_point(self, eval_info, q, p, a):
+        return p.id
+
+
+def sweep_engine():
+    return Engine(DataSource1, Preparator0, {"a": Algo0}, Serving0)
+
+
+def sweep_params(ids):
+    return [EngineParams(
+        data_source_params=DataSource1Params(id=1, en=2, qn=3),
+        algorithm_params_list=[("a", AlgoParams(id=i))]) for i in ids]
+
+
+def test_metric_evaluator_picks_best(tmp_path):
+    out = str(tmp_path / "best.json")
+    evaluator = MetricEvaluator(IdScore(), output_path=out)
+    result = evaluator.evaluate(Ctx(), sweep_engine(), sweep_params([1, 5, 3]))
+    assert result.best_score == 5.0
+    assert result.best_idx == 1
+    assert result.best_engine_params.algorithm_params_list[0][1].id == 5
+    # best.json written with the winning params
+    import json
+    saved = json.load(open(out))
+    assert saved["algorithms"][0]["params"]["id"] == 5
+    # renders
+    assert "IdScore" in result.to_one_liner()
+    assert "5.0" in result.to_json()
+    assert "<table" in result.to_html()
+
+
+def test_metric_evaluator_smaller_is_better(tmp_path):
+    metric = IdScore()
+    metric.smaller_is_better = True
+    evaluator = MetricEvaluator(metric, output_path=str(tmp_path / "b.json"))
+    result = evaluator.evaluate(Ctx(), sweep_engine(), sweep_params([4, 2, 9]))
+    assert result.best_score == 2.0
+
+
+def test_metric_evaluator_other_metrics(tmp_path):
+    evaluator = MetricEvaluator(IdScore(), other_metrics=[ZeroMetric()],
+                                output_path=str(tmp_path / "b.json"))
+    result = evaluator.evaluate(Ctx(), sweep_engine(), sweep_params([1]))
+    assert result.engine_params_scores[0][2] == [0.0]
+
+
+def test_evaluation_object(tmp_path):
+    ev = Evaluation(engine=sweep_engine(), metric=IdScore(),
+                    output_path=str(tmp_path / "b.json"))
+    result = ev.run(Ctx(), sweep_params([2, 7]))
+    assert result.best_score == 7.0
+
+
+def test_empty_sweep_rejected(tmp_path):
+    evaluator = MetricEvaluator(IdScore(), output_path=None)
+    with pytest.raises(ValueError):
+        evaluator.evaluate(Ctx(), sweep_engine(), [])
+
+
+# -- FastEval-style prefix caching -------------------------------------------
+
+class CountingDataSource(DataSource1):
+    reads = 0
+
+    def read_eval(self, ctx):
+        CountingDataSource.reads += 1
+        return super().read_eval(ctx)
+
+
+class CountingAlgo(Algo0):
+    trains = 0
+
+    def train(self, ctx, pd):
+        CountingAlgo.trains += 1
+        return super().train(ctx, pd)
+
+
+def test_cached_runner_shares_prefixes():
+    CountingDataSource.reads = 0
+    CountingAlgo.trains = 0
+    engine = Engine(CountingDataSource, Preparator0, {"a": CountingAlgo},
+                    Serving0)
+    runner = CachedEvalRunner(engine)
+    ctx = Ctx()
+    ds = DataSource1Params(id=1, en=2, qn=2)
+    # same datasource + same algo params twice, then a different algo params
+    for algo_id in (1, 1, 2):
+        runner.eval(ctx, EngineParams(
+            data_source_params=ds,
+            algorithm_params_list=[("a", AlgoParams(id=algo_id))]))
+    assert CountingDataSource.reads == 1       # datasource read once
+    assert CountingAlgo.trains == 2 * 2        # 2 folds x 2 distinct params

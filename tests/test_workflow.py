@@ -1,0 +1,131 @@
+"""Train/eval workflows with metadata + model store round trip
+(mirrors reference CoreWorkflow/EvaluationWorkflow tests)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import Engine, EngineParams, MetricEvaluator, AverageMetric
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.workflow import WorkflowContext, WorkflowParams, run_evaluation, run_train
+from predictionio_tpu.workflow.serialization import deserialize_models, serialize_models
+from predictionio_tpu.workflow.train import engine_params_of_instance, load_for_deploy
+from fake_engine import (
+    Algo0, AlgoParams, DataSource0, DataSource1, DataSource1Params,
+    Preparator0, Serving0,
+)
+
+
+@pytest.fixture()
+def meta(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "wf.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    yield Storage
+    Storage.reset()
+
+
+def engine():
+    return Engine(DataSource0, Preparator0, {"a": Algo0}, Serving0)
+
+
+def ep(algo_id=3):
+    return EngineParams(algorithm_params_list=[("a", AlgoParams(id=algo_id))])
+
+
+def test_run_train_records_instance_and_models(meta):
+    instance = run_train(engine(), ep(), engine_factory="tests.fake:engine",
+                         engine_variant="v1")
+    assert instance.status == "COMPLETED"
+    stored = meta.get_meta_data_engine_instances().get(instance.id)
+    assert stored.status == "COMPLETED"
+    assert stored.engine_variant == "v1"
+    assert '"id": 3' in stored.algorithms_params
+    blob = meta.get_model_data_models().get(instance.id)
+    assert blob is not None
+    models = deserialize_models(blob.models)
+    assert models[0].id == 3
+
+
+def test_failed_train_leaves_init(meta):
+    class BoomAlgo(Algo0):
+        def train(self, ctx, pd):
+            raise RuntimeError("boom")
+
+    eng = Engine(DataSource0, Preparator0, {"a": BoomAlgo}, Serving0)
+    with pytest.raises(RuntimeError):
+        run_train(eng, ep())
+    instances = meta.get_meta_data_engine_instances().get_all()
+    assert len(instances) == 1
+    assert instances[0].status == "INIT"  # never deployable
+    assert meta.get_meta_data_engine_instances().get_latest_completed(
+        instances[0].engine_id, "1", "default") is None
+
+
+def test_load_for_deploy_round_trip(meta):
+    eng = engine()
+    instance = run_train(eng, ep(algo_id=9))
+    latest = meta.get_meta_data_engine_instances().get_latest_completed(
+        instance.engine_id, "1", "default")
+    assert latest is not None
+    restored_ep = engine_params_of_instance(eng, latest)
+    assert restored_ep.algorithm_params_list[0][1] == AlgoParams(id=9)
+    result, ctx = load_for_deploy(eng, latest)
+    assert result.models[0].id == 9
+    pred = result.algorithms[0].predict(result.models[0],
+                                        __import__("fake_engine").Query(id=1))
+    assert pred.id == 9
+
+
+def test_run_evaluation_records_instance(meta):
+    class IdScore(AverageMetric):
+        def calculate_point(self, eval_info, q, p, a):
+            return p.id
+
+    eng = Engine(DataSource1, Preparator0, {"a": Algo0}, Serving0)
+    params = [EngineParams(
+        data_source_params=DataSource1Params(id=1, en=1, qn=2),
+        algorithm_params_list=[("a", AlgoParams(id=i))]) for i in (2, 8)]
+    ev = Evaluation(engine=eng, metric=IdScore(), output_path=None)
+    result = run_evaluation(ev, params, evaluation_class="MyEval")
+    assert result.best_score == 8.0
+    stored = meta.get_meta_data_evaluation_instances().get_completed()
+    assert len(stored) == 1
+    assert stored[0].evaluation_class == "MyEval"
+    assert "IdScore" in stored[0].evaluator_results
+    assert "8.0" in stored[0].evaluator_results_json
+
+
+def test_serialize_pytree_models():
+    models = [{"u": np.arange(4, dtype=np.float32), "v": [1, 2]}, None]
+    blob = serialize_models(models)
+    out = deserialize_models(blob)
+    assert out[1] is None
+    np.testing.assert_array_equal(out[0]["u"], np.arange(4, dtype=np.float32))
+
+
+def test_serialize_jax_arrays_to_host():
+    import jax.numpy as jnp
+
+    blob = serialize_models([{"w": jnp.ones((2, 2))}])
+    out = deserialize_models(blob)
+    assert isinstance(out[0]["w"], np.ndarray)
+
+
+def test_workflow_context_mesh(mesh8):
+    ctx = WorkflowContext.create(
+        mode="Training",
+        workflow_params=WorkflowParams(
+            runtime_conf={"mesh_shape": "4,2", "mesh_axes": "data,model"}))
+    assert ctx.mesh.axis_names == ("data", "model")
+    assert ctx.mesh.devices.shape == (4, 2)
+    assert ctx.num_devices == 8
+    ctx1 = WorkflowContext.create(mode="Serving")
+    assert ctx1.local_mesh().devices.size == 1

@@ -268,6 +268,289 @@ def accesskey_delete(key):
 
 
 # ---------------------------------------------------------------------------
+# train / deploy / eval / batchpredict (commands/Engine.scala)
+# ---------------------------------------------------------------------------
+
+def _load_engine_variant(variant_path):
+    """Read engine.json and resolve the factory + params
+    (CreateWorkflow.scala:65 + WorkflowUtils.getEngine:53 parity)."""
+    import os
+
+    from predictionio_tpu.core.base import load_class
+
+    if not os.path.exists(variant_path):
+        click.echo(f"[ERROR] {variant_path} does not exist. Aborting.")
+        sys.exit(1)
+    with open(variant_path) as f:
+        variant = json.load(f)
+    factory_path = variant.get("engineFactory")
+    if not factory_path:
+        click.echo(f"[ERROR] {variant_path} has no engineFactory. Aborting.")
+        sys.exit(1)
+    factory = load_class(factory_path)
+    engine = factory() if callable(factory) else factory.apply()
+    engine_params = engine.engine_params_from_json(variant)
+    return engine, engine_params, factory_path, variant.get("id", "default")
+
+
+@cli.command()
+@click.option("--variant", "-v", default="engine.json",
+              help="Engine variant JSON (engine.json).")
+@click.option("--batch", default="", help="Batch label.")
+@click.option("--skip-sanity-check", is_flag=True)
+@click.option("--stop-after-read", is_flag=True)
+@click.option("--stop-after-prepare", is_flag=True)
+@click.option("--mesh-shape", default=None,
+              help="Device mesh shape, e.g. 8 or 4,2.")
+@click.option("--mesh-axes", default=None, help="Mesh axis names, e.g. data,model.")
+def train(variant, batch, skip_sanity_check, stop_after_read,
+          stop_after_prepare, mesh_shape, mesh_axes):
+    """Train an engine instance (Console.scala:179, CoreWorkflow.runTrain)."""
+    from predictionio_tpu.workflow import WorkflowParams, run_train
+
+    engine, engine_params, factory_path, variant_id = \
+        _load_engine_variant(variant)
+    runtime_conf = {}
+    if mesh_shape:
+        runtime_conf["mesh_shape"] = mesh_shape
+    if mesh_axes:
+        runtime_conf["mesh_axes"] = mesh_axes
+    wp = WorkflowParams(
+        batch=batch, skip_sanity_check=skip_sanity_check,
+        stop_after_read=stop_after_read,
+        stop_after_prepare=stop_after_prepare,
+        runtime_conf=runtime_conf)
+    from predictionio_tpu.core.engine import (
+        StopAfterPrepareInterruption, StopAfterReadInterruption,
+    )
+    try:
+        instance = run_train(engine, engine_params,
+                             engine_factory=factory_path,
+                             engine_variant=variant_id, workflow_params=wp)
+    except StopAfterReadInterruption:
+        click.echo("[INFO] Training interrupted by --stop-after-read.")
+        return
+    except StopAfterPrepareInterruption:
+        click.echo("[INFO] Training interrupted by --stop-after-prepare.")
+        return
+    click.echo(f"[INFO] Training completed. Engine instance: {instance.id}")
+
+
+@cli.command()
+@click.option("--variant", "-v", default="engine.json")
+@click.option("--ip", default="localhost")
+@click.option("--port", default=8000, type=int)
+@click.option("--engine-instance-id", default=None,
+              help="Deploy a specific instance instead of the latest.")
+@click.option("--feedback", is_flag=True, help="Record query/prediction events.")
+@click.option("--event-server-app", default=None,
+              help="App name for feedback events.")
+@click.option("--accesskey", default=None,
+              help="Key required for /stop and /reload.")
+def deploy(variant, ip, port, engine_instance_id, feedback,
+           event_server_app, accesskey):
+    """Deploy the latest COMPLETED instance (Console.scala:260,
+    CreateServer.scala:109)."""
+    from predictionio_tpu.server.query_server import run_query_server
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.workflow.train import load_for_deploy
+
+    engine, _, factory_path, variant_id = _load_engine_variant(variant)
+    instances = Storage.get_meta_data_engine_instances()
+    if engine_instance_id:
+        instance = instances.get(engine_instance_id)
+        if instance is None or instance.status != "COMPLETED":
+            click.echo(f"[ERROR] Engine instance {engine_instance_id} is not "
+                       "deployable. Aborting.")
+            sys.exit(1)
+    else:
+        instance = instances.get_latest_completed(
+            factory_path, "1", variant_id)
+        if instance is None:
+            click.echo("[ERROR] No COMPLETED engine instance found. "
+                       "Run `pio train` first. Aborting.")
+            sys.exit(1)
+    click.echo(f"[INFO] Deploying engine instance {instance.id} "
+               f"at {ip}:{port}")
+    result, ctx = load_for_deploy(engine, instance)
+    run_query_server(engine, result, instance, ctx, ip=ip, port=port,
+                     feedback=feedback, feedback_app_name=event_server_app,
+                     access_key=accesskey)
+
+
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=8000, type=int)
+@click.option("--accesskey", default=None)
+def undeploy(ip, port, accesskey):
+    """Stop a deployed query server (Console.scala:318)."""
+    import urllib.request
+
+    url = f"http://{ip}:{port}/stop"
+    if accesskey:
+        url += f"?accessKey={accesskey}"
+    try:
+        with urllib.request.urlopen(
+                urllib.request.Request(url, method="POST"), timeout=10) as r:
+            click.echo(f"[INFO] {r.read().decode()}")
+    except Exception as e:
+        click.echo(f"[ERROR] Unable to undeploy: {e}")
+        sys.exit(1)
+
+
+@cli.command("eval")
+@click.argument("evaluation_path")
+@click.argument("params_generator_path", required=False)
+@click.option("--batch", default="")
+def eval_cmd(evaluation_path, params_generator_path, batch):
+    """Run an evaluation sweep (Console.scala:232).
+
+    EVALUATION_PATH: dotted path to an Evaluation object/factory;
+    PARAMS_GENERATOR_PATH: dotted path to an EngineParamsGenerator (optional
+    when the Evaluation carries its own params list).
+    """
+    from predictionio_tpu.core.base import load_class
+    from predictionio_tpu.core.evaluation import Evaluation
+    from predictionio_tpu.workflow import WorkflowParams, run_evaluation
+
+    evaluation = load_class(evaluation_path)
+    if isinstance(evaluation, type):
+        evaluation = evaluation()          # Evaluation subclass
+    elif callable(evaluation) and not isinstance(evaluation, Evaluation):
+        evaluation = evaluation()          # factory function
+    params_list = None
+    if params_generator_path:
+        gen = load_class(params_generator_path)
+        if isinstance(gen, type):
+            gen = gen()
+        elif callable(gen) and not hasattr(gen, "engine_params_list"):
+            gen = gen()
+        params_list = list(gen.engine_params_list)
+    if params_list is None:
+        params_list = list(getattr(evaluation, "engine_params_list", []))
+    if not params_list:
+        click.echo("[ERROR] No engine params to evaluate. Aborting.")
+        sys.exit(1)
+    result = run_evaluation(
+        evaluation, params_list,
+        evaluation_class=evaluation_path,
+        params_generator_class=params_generator_path or "",
+        workflow_params=WorkflowParams(batch=batch))
+    click.echo(f"[INFO] {result.to_one_liner()}")
+    click.echo("[INFO] Evaluation completed.")
+
+
+@cli.command()
+@click.option("--variant", "-v", default="engine.json")
+@click.option("--input", "input_path", required=True,
+              help="File of one JSON query per line.")
+@click.option("--output", "output_path", required=True)
+@click.option("--engine-instance-id", default=None)
+def batchpredict(variant, input_path, output_path, engine_instance_id):
+    """Batch scoring (Console.scala:331, BatchPredict.scala:71)."""
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    engine, _, factory_path, variant_id = _load_engine_variant(variant)
+    instances = Storage.get_meta_data_engine_instances()
+    instance = (instances.get(engine_instance_id) if engine_instance_id
+                else instances.get_latest_completed(factory_path, "1", variant_id))
+    if instance is None or instance.status != "COMPLETED":
+        click.echo("[ERROR] No COMPLETED engine instance found. Aborting.")
+        sys.exit(1)
+    n = run_batch_predict(engine, instance, input_path, output_path)
+    click.echo(f"[INFO] Wrote {n} predictions to {output_path}")
+
+
+# ---------------------------------------------------------------------------
+# import / export (commands/{Import,Export}.scala)
+# ---------------------------------------------------------------------------
+
+@cli.command("import")
+@click.option("--appid", type=int, default=None)
+@click.option("--appname", default=None)
+@click.option("--channel", default=None)
+@click.option("--input", "input_path", required=True,
+              help="JSON-lines file of events (FileToEvents.scala:40).")
+def import_cmd(appid, appname, channel, input_path):
+    """Import events from a JSON-lines file (Console.scala:623)."""
+    from predictionio_tpu.data.event import Event, validate_event
+    from predictionio_tpu.data.eventstore import resolve_app
+    from predictionio_tpu.storage import Storage, StorageError
+
+    if appname:
+        try:
+            app_id, channel_id = resolve_app(appname, channel)
+        except StorageError as e:
+            click.echo(f"[ERROR] {e}. Aborting.")
+            sys.exit(1)
+    elif appid is not None:
+        app_id, channel_id = appid, None
+    else:
+        click.echo("[ERROR] --appid or --appname is required.")
+        sys.exit(1)
+    store = Storage.get_events()
+    store.init_channel(app_id, channel_id)
+    BATCH = 5000
+    batch, total = [], 0
+    with open(input_path) as f:  # streamed: memory stays one batch deep
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            e = Event.from_json(line)
+            validate_event(e)
+            batch.append(e)
+            if len(batch) >= BATCH:
+                store.insert_batch(batch, app_id, channel_id)
+                total += len(batch)
+                batch = []
+    if batch:
+        store.insert_batch(batch, app_id, channel_id)
+        total += len(batch)
+    click.echo(f"[INFO] Imported {total} events.")
+
+
+@cli.command("export")
+@click.option("--appid", type=int, default=None)
+@click.option("--appname", default=None)
+@click.option("--channel", default=None)
+@click.option("--output", "output_path", required=True)
+@click.option("--format", "fmt", type=click.Choice(["json", "parquet"]),
+              default="json")
+def export_cmd(appid, appname, channel, output_path, fmt):
+    """Export events to a file (Console.scala:606, EventsToFile.scala:40)."""
+    from predictionio_tpu.data.eventstore import resolve_app
+    from predictionio_tpu.storage import Storage, StorageError
+
+    if appname:
+        try:
+            app_id, channel_id = resolve_app(appname, channel)
+        except StorageError as e:
+            click.echo(f"[ERROR] {e}. Aborting.")
+            sys.exit(1)
+    elif appid is not None:
+        app_id, channel_id = appid, None
+    else:
+        click.echo("[ERROR] --appid or --appname is required.")
+        sys.exit(1)
+    store = Storage.get_events()
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        table = store.find_columnar(app_id, channel_id)
+        pq.write_table(table, output_path)
+        n = table.num_rows
+    else:
+        n = 0
+        with open(output_path, "w") as f:
+            for e in store.find(app_id, channel_id):
+                f.write(e.to_json() + "\n")
+                n += 1
+    click.echo(f"[INFO] Exported {n} events to {output_path}.")
+
+
+# ---------------------------------------------------------------------------
 # servers
 # ---------------------------------------------------------------------------
 

@@ -107,6 +107,10 @@ class Engine:
         algo_params_classes = {
             name: params_class_of(cls)
             for name, cls in self.algorithm_classes.items()}
+        # entries omitting "name" select the single algorithm (like _pick)
+        if "" not in algo_params_classes and len(self.algorithm_classes) == 1:
+            algo_params_classes[""] = params_class_of(
+                next(iter(self.algorithm_classes.values())))
         ds_name = (data.get("datasource") or {}).get("name", "")
         prep_name = (data.get("preparator") or {}).get("name", "")
         serving_name = (data.get("serving") or {}).get("name", "")

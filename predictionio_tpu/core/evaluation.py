@@ -35,19 +35,33 @@ class EngineParamsGenerator:
 class Evaluation:
     """Glue object tying an Engine to a Metric (Evaluation.scala:34).
 
-    Subclass or instantiate with engine + metric (+ other_metrics). Setting
-    `engine_metric` wraps the metric in a MetricEvaluator that also writes
-    best.json (Evaluation.engineMetric_= sugar, :91-99).
+    Subclass (declaring engine/metric as class attributes, the reference's
+    `engineMetric =` style) or instantiate with engine + metric
+    (+ other_metrics). The evaluator writes best.json
+    (Evaluation.engineMetric_= sugar, :91-99).
     """
+
+    # class-attribute declaration point for subclasses
+    engine: Optional[Engine] = None
+    metric: Optional[Metric] = None
+    other_metrics: Sequence[Metric] = ()
+    output_path: Optional[str] = "best.json"
+    #: optional params list carried by the evaluation itself
+    engine_params_list: Sequence[EngineParams] = ()
 
     def __init__(self, engine: Optional[Engine] = None,
                  metric: Optional[Metric] = None,
-                 other_metrics: Sequence[Metric] = (),
-                 output_path: Optional[str] = "best.json"):
-        self.engine = engine
-        self.metric = metric
-        self.other_metrics = list(other_metrics)
-        self.output_path = output_path
+                 other_metrics: Optional[Sequence[Metric]] = None,
+                 output_path: Optional[str] = "__default__"):
+        # only override class-level declarations when explicitly given
+        if engine is not None:
+            self.engine = engine
+        if metric is not None:
+            self.metric = metric
+        if other_metrics is not None:
+            self.other_metrics = list(other_metrics)
+        if output_path != "__default__":
+            self.output_path = output_path
 
     @property
     def evaluator(self) -> "MetricEvaluator":
@@ -185,9 +199,17 @@ class MetricEvaluator:
                         self.metric.header(), score)
             scores.append((ep, score, others))
 
+        import math
+
+        # NaN scores (e.g. empty folds) can never win; if all are NaN the
+        # first is reported so the caller still sees the failure
         best_idx = 0
         for i in range(1, len(scores)):
-            if self.metric.compare(scores[i][1], scores[best_idx][1]) > 0:
+            cur, best = scores[i][1], scores[best_idx][1]
+            if isinstance(cur, float) and math.isnan(cur):
+                continue
+            if (isinstance(best, float) and math.isnan(best)) \
+                    or self.metric.compare(cur, best) > 0:
                 best_idx = i
         best_ep, best_score, _ = scores[best_idx]
         result = MetricEvaluatorResult(

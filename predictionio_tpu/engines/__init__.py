@@ -1,0 +1,14 @@
+"""Built-in engine templates (L6).
+
+Rebuilds the reference's judged example templates (SURVEY.md section 2.8):
+  * recommendation    <- examples/scala-parallel-recommendation (ALS)
+  * similarproduct    <- examples/scala-parallel-similarproduct (ALS implicit
+                         + cooccurrence)
+  * classification    <- examples/scala-parallel-classification (NaiveBayes,
+                         LogisticRegression)
+  * ecommerce         <- examples/scala-parallel-ecommercerecommendation
+                         (ALS + business-rule filters)
+
+Each module exposes an EngineFactory function referenced from engine.json
+("engineFactory": "predictionio_tpu.engines.recommendation:engine").
+"""

@@ -1,0 +1,7 @@
+"""Model/algorithm library (the MLlib replacement).
+
+TPU-native implementations of the algorithms the reference's judged engine
+templates use (SURVEY.md section 2.8): blockwise ALS (explicit + implicit),
+cooccurrence, categorical NaiveBayes, logistic regression, and the e2
+extras (MarkovChain, BinaryVectorizer).
+"""

@@ -1,0 +1,323 @@
+"""Blockwise ALS matrix factorization on a device mesh.
+
+The TPU-native replacement for MLlib ALS (`ALS.run`/`ALS.trainImplicit`
+invoked by the reference templates at examples/scala-parallel-recommendation/
+customize-serving/src/main/scala/ALSAlgorithm.scala:51-85 and
+examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala:60). Design
+follows the ALX pattern (PAPERS.md): users and items are sharded in
+contiguous blocks over the mesh's "data" axis; each half-sweep gathers the
+opposite (replicated) factor matrix, assembles per-segment normal equations
+with sorted segment-sums, and solves them as one batched Cholesky on the MXU.
+
+Where Spark ALS shuffles rating blocks between executors every sweep, here
+the COO ratings are resident on device (sorted twice: by user and by item)
+and the only cross-device traffic is the factor all-gather XLA inserts when
+the sharded sweep output feeds the next sweep's replicated input — exactly
+the collective-over-ICI layout SURVEY.md section 2.9 P3 prescribes.
+
+Explicit feedback uses ALS-WR weighted-lambda regularization (MLlib's
+scheme); implicit feedback implements Hu-Koren-Volinsky confidence weighting
+(c = 1 + alpha * r) with the shared V^T V Gramian trick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.ops.linalg import batched_spd_solve
+from predictionio_tpu.ops.segment import segment_gram_rhs
+
+
+@dataclasses.dataclass
+class ALSParams(Params):
+    """Hyperparameters (template ALSAlgorithmParams parity: rank,
+    numIterations, lambda, seed; implicit adds alpha)."""
+
+    rank: int = 10
+    num_iterations: int = 10
+    reg: float = 0.01
+    alpha: float = 1.0
+    implicit_prefs: bool = False
+    weighted_reg: bool = True   # ALS-WR: lambda scaled by per-entity count
+    seed: int = 3
+    chunk_size: int = 16384
+
+
+# ---------------------------------------------------------------------------
+# Host-side data layout
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ShardedCOO:
+    """Ratings sorted by segment and split into per-shard blocks.
+
+    Arrays are [n_shards, max_shard_nnz]; shard s owns contiguous segments
+    [s * seg_per_shard, (s+1) * seg_per_shard). seg is the LOCAL segment
+    index within the shard; weight 0 marks padding.
+    """
+
+    tgt: np.ndarray   # int32 — opposite-side factor row of each rating
+    seg: np.ndarray   # int32 — local segment index
+    val: np.ndarray   # float32 — rating value
+    w: np.ndarray     # float32 — confidence/validity weight
+    seg_per_shard: int
+    n_segments: int   # padded total (n_shards * seg_per_shard)
+
+
+def shard_coo(seg_idx: np.ndarray, tgt_idx: np.ndarray, values: np.ndarray,
+              n_segments: int, n_shards: int,
+              weights: Optional[np.ndarray] = None) -> ShardedCOO:
+    """Sort by segment, split at shard boundaries, pad shards to equal nnz."""
+    order = np.argsort(seg_idx, kind="stable")
+    seg_s = seg_idx[order].astype(np.int32)
+    tgt_s = tgt_idx[order].astype(np.int32)
+    val_s = values[order].astype(np.float32)
+    w_s = (weights[order].astype(np.float32) if weights is not None
+           else np.ones_like(val_s))
+
+    seg_per_shard = -(-max(n_segments, 1) // n_shards)
+    bounds = np.searchsorted(
+        seg_s, np.arange(1, n_shards) * seg_per_shard, side="left")
+    splits = np.split(np.arange(seg_s.shape[0]), bounds)
+    max_nnz = max((len(s) for s in splits), default=1) or 1
+
+    def shard_arrays(src, fill, local_seg=False):
+        out = np.full((n_shards, max_nnz), fill, dtype=src.dtype)
+        for s, idx in enumerate(splits):
+            row = src[idx]
+            if local_seg:
+                row = row - s * seg_per_shard
+            out[s, :len(idx)] = row
+        return out
+
+    w_out = np.zeros((n_shards, max_nnz), dtype=np.float32)
+    for s, idx in enumerate(splits):
+        w_out[s, :len(idx)] = w_s[idx]
+
+    return ShardedCOO(
+        tgt=shard_arrays(tgt_s, 0),
+        seg=shard_arrays(seg_s, 0, local_seg=True),
+        val=shard_arrays(val_s, 0.0),
+        w=w_out,
+        seg_per_shard=seg_per_shard,
+        n_segments=n_shards * seg_per_shard,
+    )
+
+
+@dataclasses.dataclass
+class ALSData:
+    """Device-ready training layout: the COO sorted both ways + dims."""
+
+    by_user: ShardedCOO    # seg=user, tgt=item
+    by_item: ShardedCOO    # seg=item, tgt=user
+    n_users: int
+    n_items: int
+    n_users_pad: int
+    n_items_pad: int
+    nnz: int
+
+    @classmethod
+    def build(cls, user_idx: np.ndarray, item_idx: np.ndarray,
+              ratings: np.ndarray, n_users: int, n_items: int,
+              n_shards: int) -> "ALSData":
+        by_user = shard_coo(user_idx, item_idx, ratings, n_users, n_shards)
+        by_item = shard_coo(item_idx, user_idx, ratings, n_items, n_shards)
+        return cls(by_user=by_user, by_item=by_item,
+                   n_users=n_users, n_items=n_items,
+                   n_users_pad=by_user.n_segments,
+                   n_items_pad=by_item.n_segments,
+                   nnz=int(len(ratings)))
+
+
+# ---------------------------------------------------------------------------
+# Device sweeps
+# ---------------------------------------------------------------------------
+
+def _half_sweep(opposite: jax.Array, coo_tgt, coo_seg, coo_val, coo_w,
+                seg_per_shard: int, params: ALSParams,
+                chunk_size: int) -> jax.Array:
+    """Solve this side's factors for one shard. opposite is the full
+    (replicated) opposite-side factor matrix."""
+    if params.implicit_prefs:
+        # Hu-Koren: A_s = V^T V + sum alpha*r f f^T + lam I ; b_s = sum c f
+        gram_all = opposite.T @ opposite                      # [K, K] MXU
+        gram, rhs, cnt = segment_gram_rhs(
+            opposite, coo_tgt, coo_seg,
+            values=jnp.ones_like(coo_val), weights=coo_w * (1 + params.alpha * coo_val),
+            num_segments=seg_per_shard, chunk_size=chunk_size)
+        # subtract the p=1,c=1 part double-counted? No: we accumulate
+        # c * f f^T; the Hu-Koren decomposition uses V^T V + (c-1) f f^T.
+        gram_c1, _, _ = segment_gram_rhs(
+            opposite, coo_tgt, coo_seg,
+            values=jnp.zeros_like(coo_val), weights=coo_w,
+            num_segments=seg_per_shard, chunk_size=chunk_size)
+        A = gram_all[None, :, :] + (gram - gram_c1)
+        lam = params.reg * jnp.where(params.weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
+        A = A + lam[:, None, None] * jnp.eye(opposite.shape[1], dtype=A.dtype)
+        return batched_spd_solve(A, rhs)
+    gram, rhs, cnt = segment_gram_rhs(
+        opposite, coo_tgt, coo_seg, values=coo_val, weights=coo_w,
+        num_segments=seg_per_shard, chunk_size=chunk_size)
+    lam = params.reg * jnp.where(params.weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
+    A = gram + lam[:, None, None] * jnp.eye(opposite.shape[1], dtype=gram.dtype)
+    return batched_spd_solve(A, rhs)
+
+
+def make_train_fn(mesh: Mesh, data_dims, params: ALSParams):
+    """Build the jitted full training function for the given mesh.
+
+    Returns train(by_user_arrays, by_item_arrays, key) -> (U, V), where the
+    per-shard COO arrays are sharded over the mesh's "data" axis and the
+    factor matrices flow replicated-in / sharded-out; XLA inserts the
+    all-gather between half-sweeps (collectives over ICI).
+    """
+    from jax import shard_map
+
+    n_users_pad, n_items_pad, ups, ips = data_dims
+    axis = "data"
+    k = params.rank
+    chunk = params.chunk_size
+
+    def user_block(V, tgt, seg, val, w):
+        # one shard: [1, nnz] blocks -> local users [ups, K]
+        return _half_sweep(V, tgt[0], seg[0], val[0], w[0], ups, params, chunk)[None]
+
+    def item_block(U, tgt, seg, val, w):
+        return _half_sweep(U, tgt[0], seg[0], val[0], w[0], ips, params, chunk)[None]
+
+    # check_vma=False: the generic segment kernel mixes replicated factor
+    # inputs with device-varying COO chunks inside lax.scan; correctness is
+    # covered by the single-vs-8-device equivalence test
+    coo_spec = P(axis, None)
+    user_sweep = shard_map(
+        user_block, mesh=mesh,
+        in_specs=(P(), coo_spec, coo_spec, coo_spec, coo_spec),
+        out_specs=P(axis, None, None), check_vma=False)
+    item_sweep = shard_map(
+        item_block, mesh=mesh,
+        in_specs=(P(), coo_spec, coo_spec, coo_spec, coo_spec),
+        out_specs=P(axis, None, None), check_vma=False)
+
+    def train(by_user, by_item, key):
+        u_tgt, u_seg, u_val, u_w = by_user
+        i_tgt, i_seg, i_val, i_w = by_item
+        V = (jax.random.normal(key, (n_items_pad, k), jnp.float32)
+             / jnp.sqrt(jnp.asarray(k, jnp.float32)))
+
+        def body(_, carry):
+            U, V = carry
+            U = user_sweep(V, u_tgt, u_seg, u_val, u_w).reshape(n_users_pad, k)
+            V = item_sweep(U, i_tgt, i_seg, i_val, i_w).reshape(n_items_pad, k)
+            return (U, V)
+
+        U0 = jnp.zeros((n_users_pad, k), jnp.float32)
+        U, V = jax.lax.fori_loop(0, params.num_iterations, body, (U0, V))
+        return U, V
+
+    return jax.jit(train)
+
+
+def train_als(mesh: Mesh, data: ALSData, params: ALSParams
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Train and return host (U [n_users, K], V [n_items, K])."""
+    n_shards = int(np.prod(mesh.devices.shape))
+    assert data.by_user.tgt.shape[0] == n_shards, \
+        f"data built for {data.by_user.tgt.shape[0]} shards, mesh has {n_shards}"
+    train = make_train_fn(
+        mesh, (data.n_users_pad, data.n_items_pad,
+               data.by_user.seg_per_shard, data.by_item.seg_per_shard), params)
+    key = jax.random.PRNGKey(params.seed)
+    bu = (data.by_user.tgt, data.by_user.seg, data.by_user.val, data.by_user.w)
+    bi = (data.by_item.tgt, data.by_item.seg, data.by_item.val, data.by_item.w)
+    U, V = train(bu, bi, key)
+    U = np.asarray(jax.device_get(U))[:data.n_users]
+    V = np.asarray(jax.device_get(V))[:data.n_items]
+    return U, V
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num",))
+def _topk_scores(user_vec: jax.Array, V: jax.Array, mask: jax.Array,
+                 num: int) -> Tuple[jax.Array, jax.Array]:
+    scores = V @ user_vec                       # [n_items] MXU matvec
+    scores = jnp.where(mask, -jnp.inf, scores)
+    return jax.lax.top_k(scores, num)
+
+
+@dataclasses.dataclass
+class ALSModel:
+    """Trained factors + id maps (template ALSModel.scala:33-80 analog).
+
+    Picklable pytree-of-numpy; recommend() runs the scoring matvec jitted.
+    """
+
+    user_vocab: np.ndarray   # sorted distinct user ids (index = row of U)
+    item_vocab: np.ndarray   # sorted distinct item ids (index = row of V)
+    U: np.ndarray            # [n_users, K]
+    V: np.ndarray            # [n_items, K]
+
+    def user_index(self, user_id: str) -> Optional[int]:
+        i = np.searchsorted(self.user_vocab, user_id)
+        if i < len(self.user_vocab) and self.user_vocab[i] == user_id:
+            return int(i)
+        return None
+
+    def item_index(self, item_id: str) -> Optional[int]:
+        i = np.searchsorted(self.item_vocab, item_id)
+        if i < len(self.item_vocab) and self.item_vocab[i] == item_id:
+            return int(i)
+        return None
+
+    def predict_rating(self, user_id: str, item_id: str) -> Optional[float]:
+        ui, ii = self.user_index(user_id), self.item_index(item_id)
+        if ui is None or ii is None:
+            return None
+        return float(self.U[ui] @ self.V[ii])
+
+    def recommend(self, user_id: str, num: int,
+                  exclude_items: Tuple[str, ...] = (),
+                  allow_items: Optional[Tuple[str, ...]] = None):
+        """Top-num (item_id, score), optionally excluding/allowlisting."""
+        ui = self.user_index(user_id)
+        if ui is None:
+            return []
+        mask = np.zeros(len(self.item_vocab), dtype=bool)
+        for it in exclude_items:
+            ii = self.item_index(it)
+            if ii is not None:
+                mask[ii] = True
+        if allow_items is not None:
+            allow = np.ones(len(self.item_vocab), dtype=bool)
+            for it in allow_items:
+                ii = self.item_index(it)
+                if ii is not None:
+                    allow[ii] = False
+            mask |= allow
+        k = min(num, len(self.item_vocab))
+        scores, idx = _topk_scores(
+            jnp.asarray(self.U[ui]), jnp.asarray(self.V), jnp.asarray(mask), k)
+        scores = np.asarray(scores)
+        idx = np.asarray(idx)
+        out = []
+        for s, i in zip(scores, idx):
+            if np.isfinite(s):
+                out.append((str(self.item_vocab[i]), float(s)))
+        return out
+
+
+def rmse(model_U: np.ndarray, model_V: np.ndarray, user_idx: np.ndarray,
+         item_idx: np.ndarray, ratings: np.ndarray) -> float:
+    """Held-out RMSE of r_hat = u . v (the judged metric)."""
+    pred = np.einsum("nk,nk->n", model_U[user_idx], model_V[item_idx])
+    return float(np.sqrt(np.mean((pred - ratings) ** 2)))

@@ -1,0 +1,8 @@
+"""JAX compute kernels (the rebuild's "native layer").
+
+The reference's kernel layer is Spark MLlib invoked from engine templates
+(SURVEY.md intro); here it is hand-written JAX designed for the TPU:
+segment-sum Gramians feeding the MXU-batched Cholesky solves of ALS,
+vectorized counting for NaiveBayes, optax-driven LogReg, and sparse
+cooccurrence counting.
+"""

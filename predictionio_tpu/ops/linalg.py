@@ -1,0 +1,25 @@
+"""Batched dense linear algebra for the MXU.
+
+Batched positive-definite solves: the per-segment normal equations of ALS
+([S, K, K] @ x = [S, K]) solved with Cholesky, the shape XLA tiles onto the
+MXU as batched K x K matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def batched_spd_solve(A: jax.Array, b: jax.Array,
+                      jitter: float = 1e-6) -> jax.Array:
+    """Solve A[s] x[s] = b[s] for SPD A, [S, K, K] x [S, K] -> [S, K].
+
+    A small diagonal jitter keeps empty segments (A ~ 0) from producing
+    NaNs; their rhs is 0 so the solution stays 0.
+    """
+    k = A.shape[-1]
+    A = A + jitter * jnp.eye(k, dtype=A.dtype)
+    chol, lower = jax.scipy.linalg.cho_factor(A)
+    return jax.scipy.linalg.cho_solve((chol, lower), b)

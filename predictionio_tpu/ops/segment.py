@@ -1,0 +1,94 @@
+"""Chunked segment reductions for normal-equation assembly.
+
+The TPU-native replacement for MLlib ALS's shuffle-based rating-block
+aggregation (invoked from the reference templates at
+examples/.../ALSAlgorithm.scala:85): for every segment (user or item) we
+accumulate the Gramian sum_j w_j f_j f_j^T and right-hand side
+sum_j v_j f_j over that segment's ratings.
+
+Design for the hardware (SURVEY.md section 2.9 P3/P4):
+  * ratings arrive pre-sorted by segment id -> scatter-adds are
+    indices_are_sorted and XLA lowers them to efficient sorted-segment sums
+  * nnz is processed in fixed-size chunks under lax.scan so the temporary
+    outer-product buffer (chunk x K x K) stays bounded regardless of dataset
+    size (20M ratings never materialize a [nnz, K, K] tensor)
+  * all shapes are static: nnz is padded to a chunk multiple with weight-0
+    rows pointing at a scratch segment
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
+    n = arr.shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple if n else multiple
+    if target == n:
+        return arr
+    pad = np.full((target - n,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_segments", "chunk_size"))
+def segment_gram_rhs(
+    factors: jax.Array,       # [F, K] factor matrix indexed by tgt_idx
+    tgt_idx: jax.Array,       # [N] which factor row each rating touches
+    seg_idx: jax.Array,       # [N] which segment each rating belongs to (sorted)
+    values: jax.Array,        # [N] rating values (rhs weights)
+    weights: jax.Array,       # [N] confidence/validity weights (0 = padding)
+    num_segments: int,
+    chunk_size: int = 16384,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (gram [S, K, K], rhs [S, K], count [S]).
+
+    gram[s]  = sum_{j in s} w_j f_j f_j^T
+    rhs[s]   = sum_{j in s} w_j v_j f_j
+    count[s] = sum_{j in s} w_j
+    """
+    k = factors.shape[-1]
+    n = tgt_idx.shape[0]
+    num_chunks = max(1, (n + chunk_size - 1) // chunk_size)
+    padded = num_chunks * chunk_size
+    if padded != n:
+        # weight-0 padding rows scatter into segment 0 harmlessly
+        pad = padded - n
+        tgt_idx = jnp.concatenate([tgt_idx, jnp.zeros(pad, tgt_idx.dtype)])
+        seg_idx = jnp.concatenate([seg_idx, jnp.zeros(pad, seg_idx.dtype)])
+        values = jnp.concatenate([values, jnp.zeros(pad, values.dtype)])
+        weights = jnp.concatenate([weights, jnp.zeros(pad, weights.dtype)])
+
+    tgt_c = tgt_idx.reshape(num_chunks, chunk_size)
+    seg_c = seg_idx.reshape(num_chunks, chunk_size)
+    val_c = values.reshape(num_chunks, chunk_size)
+    w_c = weights.reshape(num_chunks, chunk_size)
+
+    def body(carry, chunk):
+        gram, rhs, count = carry
+        tgt, seg, val, w = chunk
+        f = factors[tgt]                                   # [C, K] gather
+        fw = f * w[:, None]
+        outer = jnp.einsum("ck,cl->ckl", fw, f)            # [C, K, K]
+        gram = gram.at[seg].add(outer, indices_are_sorted=False)
+        rhs = rhs.at[seg].add(f * (val * w)[:, None])
+        count = count.at[seg].add(w)
+        return (gram, rhs, count), None
+
+    init = (jnp.zeros((num_segments, k, k), factors.dtype),
+            jnp.zeros((num_segments, k), factors.dtype),
+            jnp.zeros((num_segments,), factors.dtype))
+    (gram, rhs, count), _ = jax.lax.scan(
+        body, init, (tgt_c, seg_c, val_c, w_c))
+    return gram, rhs, count
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_count(seg_idx: jax.Array, weights: jax.Array,
+                  num_segments: int) -> jax.Array:
+    return jnp.zeros((num_segments,), weights.dtype).at[seg_idx].add(weights)

@@ -1,0 +1,259 @@
+"""Query server — deployed engine REST serving on port 8000.
+
+Parity with the reference CreateServer/PredictionServer
+(core/.../workflow/CreateServer.scala:104-706):
+
+  GET  /               -> engine/instance info + serving stats   (:460-482)
+  POST /queries.json   -> the prediction hot path                (:484-605)
+  GET  /reload         -> reload latest COMPLETED instance       (:642-652)
+  POST /stop           -> graceful shutdown (key auth)           (:635-641)
+  GET  /plugins.json   -> engine server plugin registry
+
+The hot path (:508 runs algorithms serially and says "TODO: Parallelize";
+SURVEY.md P7): here the model's factor matrices stay resident as device
+arrays inside the model objects, queries run through jitted scoring, and the
+serial per-algorithm loop remains only as Python orchestration around
+device-resident compute.
+
+Feedback loop (:527-589): when feedback=True, each query/prediction pair is
+written back to the event store as a `predict` event with prId tagging.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import time
+from typing import Any, Optional
+
+from aiohttp import web
+
+from predictionio_tpu.core.engine import Engine, TrainResult
+from predictionio_tpu.core.params import params_from_json
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, UTC
+from predictionio_tpu.server.plugins import PluginContext
+from predictionio_tpu.storage.base import EngineInstance, generate_id
+from predictionio_tpu.storage.registry import Storage
+
+logger = logging.getLogger("pio.queryserver")
+
+DEFAULT_PORT = 8000
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if hasattr(obj, "to_dict"):
+        return obj.to_dict()
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    return obj
+
+
+def _query_class(train_result: TrainResult) -> Optional[type]:
+    """Runtime query class resolution (BaseAlgorithm.queryClass:122 analog):
+    an explicit `query_class` on the algorithm, else the annotation of
+    predict's query parameter."""
+    for algo in train_result.algorithms:
+        qc = getattr(algo, "query_class", None)
+        if qc is not None:
+            return qc
+        try:
+            import typing
+
+            hints = typing.get_type_hints(type(algo).predict)
+            qc = hints.get("query")
+            if isinstance(qc, type) and dataclasses.is_dataclass(qc):
+                return qc
+        except Exception:
+            pass
+    return None
+
+
+class QueryServer:
+    def __init__(self, engine: Engine, train_result: TrainResult,
+                 instance: EngineInstance, ctx,
+                 feedback: bool = False,
+                 feedback_app_name: Optional[str] = None,
+                 access_key: Optional[str] = None,
+                 plugin_context: Optional[PluginContext] = None):
+        self.engine = engine
+        self.result = train_result
+        self.instance = instance
+        self.ctx = ctx
+        self.feedback = feedback
+        self.feedback_app_name = feedback_app_name
+        # resolve the feedback app once; a per-query metadata lookup would
+        # sit on the hot path
+        self._feedback_target = None
+        if feedback and feedback_app_name:
+            from predictionio_tpu.data.eventstore import resolve_app
+
+            self._feedback_target = resolve_app(feedback_app_name)
+        self.access_key = access_key
+        self.plugins = plugin_context or PluginContext(
+            "predictionio_tpu.engineserver_plugins")
+        self.start_time = _dt.datetime.now(tz=UTC)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self._stop_event = asyncio.Event()
+        self.app = web.Application()
+        self._routes()
+
+    def _routes(self):
+        r = self.app.router
+        r.add_get("/", self.handle_root)
+        r.add_post("/queries.json", self.handle_query)
+        r.add_get("/reload", self.handle_reload)
+        r.add_post("/stop", self.handle_stop)
+        r.add_get("/plugins.json", self.handle_plugins)
+
+    # -- info ---------------------------------------------------------------
+    async def handle_root(self, request):
+        return web.json_response({
+            "status": "alive",
+            "engineInstance": {
+                "id": self.instance.id,
+                "engineId": self.instance.engine_id,
+                "engineVariant": self.instance.engine_variant,
+                "startTime": self.instance.start_time.isoformat(),
+            },
+            "algorithms": [type(a).__name__ for a in self.result.algorithms],
+            "startTime": self.start_time.isoformat(),
+            "requestCount": self.request_count,
+            "avgServingSec": self.avg_serving_sec,
+            "lastServingSec": self.last_serving_sec,
+        })
+
+    # -- hot path (CreateServer.scala:484-605) -------------------------------
+    async def handle_query(self, request):
+        t0 = time.perf_counter()
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"message": str(e)}, status=400)
+        try:
+            query = self._extract_query(body)
+            loop = asyncio.get_running_loop()
+            prediction = await loop.run_in_executor(None, self._predict, query)
+        except Exception as e:
+            logger.exception("query failed")
+            return web.json_response({"message": str(e)}, status=400)
+
+        pred_json = _to_jsonable(prediction)
+        # feedback loop: tag with prId and record events (:527-589)
+        if self.feedback and self.feedback_app_name:
+            pr_id = (pred_json.get("prId") if isinstance(pred_json, dict)
+                     else None) or generate_id()
+            if isinstance(pred_json, dict):
+                pred_json = dict(pred_json)
+                pred_json["prId"] = pr_id
+            asyncio.get_running_loop().run_in_executor(
+                None, self._record_feedback, body, pred_json, pr_id)
+        # output blockers transform; sniffers observe
+        for blocker in self.plugins.output_blockers.values():
+            try:
+                pred_json = blocker.process(self.instance, body, pred_json)
+            except Exception:
+                logger.exception("output blocker failed")
+        for sniffer in self.plugins.output_sniffers.values():
+            try:
+                sniffer.process(self.instance, body, pred_json)
+            except Exception:
+                logger.exception("output sniffer failed")
+
+        dt = time.perf_counter() - t0
+        self.request_count += 1
+        self.last_serving_sec = dt
+        self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        return web.json_response(pred_json)
+
+    def _extract_query(self, body: dict):
+        qc = _query_class(self.result)
+        if qc is None:
+            return body
+        return params_from_json(body, qc)
+
+    def _predict(self, query):
+        supplemented = self.result.serving.supplement(query)
+        predictions = [
+            algo.predict(model, supplemented)
+            for algo, model in zip(self.result.algorithms, self.result.models)]
+        return self.result.serving.serve(query, predictions)
+
+    def _record_feedback(self, query_json, pred_json, pr_id):
+        """Write predict/actual linkage events (CreateServer.scala:563-589)."""
+        try:
+            app_id, channel_id = self._feedback_target
+            event = Event(
+                event="predict",
+                entity_type="pio_pr",
+                entity_id=pr_id,
+                properties=DataMap({"query": query_json,
+                                    "prediction": pred_json}),
+            )
+            Storage.get_events().insert(event, app_id, channel_id)
+        except Exception:
+            logger.exception("feedback recording failed")
+
+    # -- management ----------------------------------------------------------
+    def _authorized(self, request) -> bool:
+        if not self.access_key:
+            return True
+        return request.query.get("accessKey") == self.access_key
+
+    async def handle_reload(self, request):
+        """Re-read the latest COMPLETED instance (:342-371 ReloadServer)."""
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        from predictionio_tpu.workflow.train import load_for_deploy
+
+        instances = Storage.get_meta_data_engine_instances()
+        latest = instances.get_latest_completed(
+            self.instance.engine_id, self.instance.engine_version,
+            self.instance.engine_variant)
+        if latest is None:
+            return web.json_response(
+                {"message": "No COMPLETED instance found"}, status=404)
+        loop = asyncio.get_running_loop()
+        result, ctx = await loop.run_in_executor(
+            None, load_for_deploy, self.engine, latest)
+        # swap under the running loop — double-buffered reload
+        self.result = result
+        self.ctx = ctx
+        self.instance = latest
+        logger.info("reloaded engine instance %s", latest.id)
+        return web.json_response({"message": "Reloaded",
+                                  "engineInstanceId": latest.id})
+
+    async def handle_stop(self, request):
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        self._stop_event.set()
+        asyncio.get_running_loop().call_later(0.2, _raise_shutdown)
+        return web.json_response({"message": "Shutting down"})
+
+    async def handle_plugins(self, request):
+        return web.json_response({"plugins": self.plugins.describe()})
+
+
+def _raise_shutdown():
+    raise web.GracefulExit()
+
+
+def create_query_server(engine: Engine, train_result: TrainResult,
+                        instance: EngineInstance, ctx,
+                        **kwargs) -> QueryServer:
+    return QueryServer(engine, train_result, instance, ctx, **kwargs)
+
+
+def run_query_server(engine: Engine, train_result: TrainResult,
+                     instance: EngineInstance, ctx,
+                     ip: str = "localhost", port: int = DEFAULT_PORT,
+                     **kwargs) -> None:
+    server = create_query_server(engine, train_result, instance, ctx, **kwargs)
+    logger.info("Query server listening on %s:%s", ip, port)
+    web.run_app(server.app, host=ip, port=port, print=None)

@@ -1,0 +1,66 @@
+"""Batch prediction: score a file of queries through a trained engine.
+
+Parity with the reference BatchPredict (core/.../workflow/BatchPredict.scala:37-235):
+input file of one JSON query per line -> restore the latest COMPLETED
+instance -> supplement/predict/serve per query -> output file of
+self-descriptive {"query": ..., "prediction": ...} lines (:196-228).
+
+The reference maps the full pipeline per query over an RDD (P8 in SURVEY.md);
+here queries are processed in chunks so algorithms with vectorized
+batch_predict implementations amortize device dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.params import params_from_json
+from predictionio_tpu.server.query_server import _query_class, _to_jsonable
+from predictionio_tpu.storage.base import EngineInstance
+
+logger = logging.getLogger("pio.batchpredict")
+
+
+def run_batch_predict(engine: Engine, instance: EngineInstance,
+                      input_path: str, output_path: str,
+                      chunk_size: int = 1024) -> int:
+    """Returns the number of predictions written."""
+    from predictionio_tpu.workflow.train import load_for_deploy
+
+    result, ctx = load_for_deploy(engine, instance)
+    qc = _query_class(result)
+
+    n = 0
+    with open(input_path) as fin, open(output_path, "w") as fout:
+        chunk = []
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            chunk.append(json.loads(line))
+            if len(chunk) >= chunk_size:
+                n += _process_chunk(result, qc, chunk, fout)
+                chunk = []
+        if chunk:
+            n += _process_chunk(result, qc, chunk, fout)
+    logger.info("batch predict: %d predictions -> %s", n, output_path)
+    return n
+
+
+def _process_chunk(result, qc, chunk, fout) -> int:
+    queries = [params_from_json(q, qc) if qc else q for q in chunk]
+    supplemented = [(i, result.serving.supplement(q))
+                    for i, q in enumerate(queries)]
+    per_algo = []
+    for algo, model in zip(result.algorithms, result.models):
+        per_algo.append(dict(algo.batch_predict(model, supplemented)))
+    for i, (raw, q) in enumerate(zip(chunk, queries)):
+        predictions = [preds[i] for preds in per_algo]
+        served = result.serving.serve(q, predictions)
+        fout.write(json.dumps(
+            {"query": raw, "prediction": _to_jsonable(served)},
+            sort_keys=True) + "\n")
+    return len(chunk)

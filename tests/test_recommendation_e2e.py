@@ -1,0 +1,179 @@
+"""End-to-end quickstart scenario.
+
+Mirrors tests/pio_tests/scenarios/quickstart_test.py in the reference: import
+rating events -> train the recommendation engine -> deploy -> query over HTTP
+-> itemScores come back (the reference asserts 4 itemScores for MovieLens
+sample data, quickstart_test.py:86-95).
+"""
+
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.engines.recommendation import (
+    PrecisionAtK, Query, default_engine_params, engine as engine_factory,
+)
+from predictionio_tpu.server.query_server import create_query_server
+from predictionio_tpu.storage import App, Storage
+from predictionio_tpu.workflow import run_train
+from predictionio_tpu.workflow.train import load_for_deploy
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture()
+def app_with_ratings(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "e2e.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    from predictionio_tpu.data.eventstore import clear_cache
+    clear_cache()
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="MyApp1"))
+    store = Storage.get_events()
+    store.init_channel(app_id)
+
+    # synthetic MovieLens-like: 30 users x 20 items, block structure
+    rng = np.random.default_rng(7)
+    events = []
+    for u in range(30):
+        for it in range(20):
+            if (u % 2) == (it % 2) and rng.random() < 0.7:
+                rating = float(rng.integers(3, 6))   # liked
+            elif rng.random() < 0.2:
+                rating = float(rng.integers(1, 3))   # disliked
+            else:
+                continue
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{it}",
+                properties=DataMap({"rating": rating})))
+    # some buy events (implicit 4.0)
+    for u in range(0, 30, 5):
+        events.append(Event(
+            event="buy", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id=f"i{(u * 3) % 20}"))
+    store.insert_batch(events, app_id)
+    yield "MyApp1"
+    Storage.reset()
+    clear_cache()
+
+
+def train_instance(app_name):
+    engine = engine_factory()
+    ep = default_engine_params(app_name, rank=8, num_iterations=8)
+    instance = run_train(
+        engine, ep,
+        engine_factory="predictionio_tpu.engines.recommendation:engine")
+    return engine, instance
+
+
+async def test_train_deploy_query(app_with_ratings):
+    engine, instance = train_instance(app_with_ratings)
+    assert instance.status == "COMPLETED"
+
+    result, ctx = load_for_deploy(engine, instance)
+    server = create_query_server(engine, result, instance, ctx)
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        # quickstart assertion: query returns `num` item scores
+        resp = await c.post("/queries.json", json={"user": "u1", "num": 4})
+        assert resp.status == 200
+        body = await resp.json()
+        assert len(body["itemScores"]) == 4
+        scores = [s["score"] for s in body["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+        # user u1 (odd group) should get odd items on top
+        odd_in_top = sum(int(s["item"][1:]) % 2 == 1
+                         for s in body["itemScores"])
+        assert odd_in_top >= 3
+
+        # unknown user -> empty scores, not an error
+        resp = await c.post("/queries.json", json={"user": "ghost", "num": 4})
+        assert (await resp.json())["itemScores"] == []
+
+        # malformed query -> 400
+        resp = await c.post("/queries.json", json={"flavor": "?"})
+        assert resp.status == 400
+        resp = await c.post("/queries.json", data=b"not json")
+        assert resp.status == 400
+
+        # status page tracks serving
+        resp = await c.get("/")
+        info = await resp.json()
+        assert info["requestCount"] >= 1
+        assert info["engineInstance"]["id"] == instance.id
+    finally:
+        await c.close()
+
+
+async def test_reload_endpoint(app_with_ratings):
+    engine, instance = train_instance(app_with_ratings)
+    result, ctx = load_for_deploy(engine, instance)
+    server = create_query_server(engine, result, instance, ctx,
+                                 access_key="sekret")
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        # unauthorized without key
+        assert (await c.get("/reload")).status == 401
+        # train a second instance, reload picks it up
+        _, instance2 = train_instance(app_with_ratings)
+        resp = await c.get("/reload?accessKey=sekret")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["engineInstanceId"] == instance2.id
+        assert server.instance.id == instance2.id
+    finally:
+        await c.close()
+
+
+def test_precision_at_k_eval(app_with_ratings):
+    from predictionio_tpu.core import Evaluation
+    from predictionio_tpu.engines.recommendation import (
+        AlgorithmParams, DataSourceParams,
+    )
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.workflow import run_evaluation
+
+    engine = engine_factory()
+    params = [EngineParams(
+        data_source_params=DataSourceParams(
+            app_name=app_with_ratings,
+            eval_params={"kFold": 2, "queryNum": 5}),
+        algorithm_params_list=[("als", AlgorithmParams(
+            rank=r, num_iterations=6))]) for r in (4, 8)]
+    ev = Evaluation(engine=engine, metric=PrecisionAtK(k=5),
+                    output_path=None)
+    result = run_evaluation(ev, params)
+    # each query holds out exactly ONE positive, so Precision@5 <= 1/5
+    assert 0.0 <= result.best_score <= 0.2
+    assert result.best_idx in (0, 1)
+    assert len(result.engine_params_scores) == 2
+    # the evaluation instance was recorded
+    stored = Storage.get_meta_data_evaluation_instances().get_completed()
+    assert len(stored) == 1
+
+
+def test_batch_predict(app_with_ratings, tmp_path):
+    engine, instance = train_instance(app_with_ratings)
+    inp = tmp_path / "queries.json"
+    out = tmp_path / "predictions.json"
+    inp.write_text('{"user": "u1", "num": 3}\n{"user": "u2", "num": 2}\n')
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    n = run_batch_predict(engine, instance, str(inp), str(out))
+    assert n == 2
+    lines = [json.loads(x) for x in out.read_text().splitlines()]
+    assert lines[0]["query"] == {"user": "u1", "num": 3}
+    assert len(lines[0]["prediction"]["itemScores"]) == 3
+    assert len(lines[1]["prediction"]["itemScores"]) == 2

@@ -95,6 +95,18 @@ class BiMap(Generic[K, V]):
         return cls({str(k): float(i) for i, k in enumerate(uniq)})
 
 
+def vocab_index(vocab: np.ndarray, key: str) -> "int | None":
+    """Index of `key` in a sorted vocab array (binary search), else None.
+
+    The shared lookup for every model's user/item id maps (the inverse
+    direction of assign_indices).
+    """
+    i = int(np.searchsorted(vocab, key))
+    if i < len(vocab) and vocab[i] == key:
+        return i
+    return None
+
+
 def assign_indices(values: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
     """Vectorized distinct-id assignment for the training path.
 

@@ -169,10 +169,8 @@ class ALSAlgorithm(Algorithm):
         values = np.asarray([r.rating for r in pd.ratings], dtype=np.float32)
         user_vocab, user_codes = assign_indices(users)
         item_vocab, item_codes = assign_indices(items)
-        mesh = getattr(ctx, "mesh", None)
-        if mesh is None:
-            from predictionio_tpu.workflow.context import WorkflowContext
-            mesh = WorkflowContext.create(mode="Training").mesh
+        from predictionio_tpu.workflow.context import mesh_of
+        mesh = mesh_of(ctx)
         n_shards = int(np.prod(mesh.devices.shape))
         data = ALSData.build(user_codes, item_codes, values,
                              len(user_vocab), len(item_vocab), n_shards)

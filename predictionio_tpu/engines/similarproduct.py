@@ -1,0 +1,361 @@
+"""Similar-product engine template (implicit ALS + cooccurrence, multi-algo).
+
+Rebuilds examples/scala-parallel-similarproduct/multi-events-multi-algos (the
+second judged config): users/items from `$set` aggregateProperties, view/like
+events, three algorithms sharing one Query/PredictedResult shape:
+
+  * ALSAlgorithm          <- ALSAlgorithm.scala:60-200 — implicit ALS on
+    deduplicated view counts; predict = summed cosine similarity between the
+    query items' factors and all item factors (vectorized to one MXU matmul)
+  * CooccurrenceAlgorithm <- CooccurrenceAlgorithm.scala:44+ — top-N
+    cooccurring items (models/cooccurrence.py)
+  * LikeAlgorithm         <- LikeAlgorithm.scala — like/dislike events,
+    latest event per (user, item) wins, like=+1 / dislike=-1 into implicit ALS
+
+Query: {"items": [...], "num": N, "categories"?, "whiteList"?, "blackList"?};
+result: {"itemScores": [{"item": ..., "score": ...}]}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import Engine, EngineParams, FirstServing, Params, Preparator
+from predictionio_tpu.core.base import Algorithm, DataSource
+from predictionio_tpu.data.bimap import assign_indices, vocab_index
+from predictionio_tpu.data.event import millis
+from predictionio_tpu.data.eventstore import EventStoreClient
+from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+from predictionio_tpu.models.cooccurrence import CooccurrenceModel, train_cooccurrence
+
+
+# -- data types ---------------------------------------------------------------
+
+@dataclasses.dataclass
+class Item:
+    categories: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class ViewEvent:
+    user: str
+    item: str
+    t: int
+
+
+@dataclasses.dataclass
+class LikeEvent:
+    user: str
+    item: str
+    t: int
+    like: bool
+
+
+@dataclasses.dataclass
+class TrainingData:
+    users: Dict[str, dict]
+    items: Dict[str, Item]
+    view_events: List[ViewEvent]
+    like_events: List[LikeEvent]
+
+
+PreparedData = TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    items: Tuple[str, ...]
+    num: int
+    categories: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "items", tuple(self.items))
+        for f in ("categories", "white_list", "black_list"):
+            v = getattr(self, f)
+            if v is not None:
+                object.__setattr__(self, f, tuple(v))
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    item_scores: List[ItemScore]
+
+    def to_dict(self):
+        return {"itemScores": [{"item": s.item, "score": s.score}
+                               for s in self.item_scores]}
+
+
+# -- DASE ---------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DataSourceParams(Params):
+    app_name: str
+
+
+class SimilarProductDataSource(DataSource):
+    """DataSource.scala parity: users/items from aggregated `$set`s, view
+    and like events."""
+
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx) -> TrainingData:
+        app = self.params.app_name
+        users = {uid: dict(pm.fields) for uid, pm in
+                 EventStoreClient.aggregate_properties(app, "user").items()}
+        items = {iid: Item(categories=pm.get_opt("categories"))
+                 for iid, pm in
+                 EventStoreClient.aggregate_properties(app, "item").items()}
+        views, likes = [], []
+        for e in EventStoreClient.find(
+                app_name=app, entity_type="user",
+                event_names=["view", "like", "dislike"],
+                target_entity_type="item"):
+            t = millis(e.event_time)
+            if e.event == "view":
+                views.append(ViewEvent(e.entity_id, e.target_entity_id, t))
+            else:
+                likes.append(LikeEvent(e.entity_id, e.target_entity_id, t,
+                                       like=(e.event == "like")))
+        return TrainingData(users=users, items=items, view_events=views,
+                            like_events=likes)
+
+
+class SimilarProductPreparator(Preparator):
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return td
+
+
+@dataclasses.dataclass
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    reg: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+
+
+@dataclasses.dataclass
+class SimilarityModel:
+    """Item factors + metadata for cosine-similarity scoring."""
+
+    item_vocab: np.ndarray
+    V: np.ndarray                     # [n_items, K] row-normalized
+    items: Dict[int, Item]
+
+    def item_index(self, item_id: str) -> Optional[int]:
+        return vocab_index(self.item_vocab, item_id)
+
+
+def _candidate_ok(idx: int, items: Dict[int, Item],
+                  query_idx: set, query: Query,
+                  white: Optional[set], black: set) -> bool:
+    """isCandidateItem parity (CooccurrenceAlgorithm.scala / ALSAlgorithm)."""
+    if idx in query_idx:
+        return False
+    if white is not None and idx not in white:
+        return False
+    if idx in black:
+        return False
+    if query.categories:
+        cats = (items.get(idx) or Item()).categories or []
+        if not set(query.categories) & set(cats):
+            return False
+    return True
+
+
+def _score_and_filter(model: SimilarityModel, scores: np.ndarray,
+                      query: Query, query_idx: set) -> PredictedResult:
+    white = None
+    if query.white_list is not None:
+        white = {i for i in (model.item_index(x) for x in query.white_list)
+                 if i is not None}
+    black = set()
+    if query.black_list is not None:
+        black = {i for i in (model.item_index(x) for x in query.black_list)
+                 if i is not None}
+    order = np.argsort(-scores)
+    out = []
+    for idx in order:
+        idx = int(idx)
+        if scores[idx] <= 0:
+            break
+        if not _candidate_ok(idx, model.items, query_idx, query, white, black):
+            continue
+        out.append(ItemScore(item=str(model.item_vocab[idx]),
+                             score=float(scores[idx])))
+        if len(out) >= query.num:
+            break
+    return PredictedResult(item_scores=out)
+
+
+class ALSAlgorithm(Algorithm):
+    """Implicit ALS on view counts; cosine-similarity predict."""
+
+    params_class = ALSAlgorithmParams
+
+    def __init__(self, params: Optional[ALSAlgorithmParams] = None):
+        self.params = params or ALSAlgorithmParams()
+
+    def _ratings(self, pd: PreparedData) -> List[Tuple[str, str, float]]:
+        counts: Dict[Tuple[str, str], float] = {}
+        for v in pd.view_events:
+            counts[(v.user, v.item)] = counts.get((v.user, v.item), 0) + 1
+        return [(u, i, c) for (u, i), c in counts.items()]
+
+    def train(self, ctx, pd: PreparedData) -> SimilarityModel:
+        ratings = self._ratings(pd)
+        if not ratings:
+            raise ValueError("view/like events cannot be empty "
+                             "(ALSAlgorithm.scala:66 require parity)")
+        if not pd.items:
+            raise ValueError("items cannot be empty (use $set item events)")
+        users = np.asarray([r[0] for r in ratings], dtype=object)
+        items = np.asarray([r[1] for r in ratings], dtype=object)
+        values = np.asarray([r[2] for r in ratings], dtype=np.float32)
+        user_vocab, user_codes = assign_indices(users)
+        item_vocab, item_codes = assign_indices(items)
+        from predictionio_tpu.workflow.context import mesh_of
+        mesh = mesh_of(ctx)
+        n_shards = int(np.prod(mesh.devices.shape))
+        data = ALSData.build(user_codes, item_codes, values,
+                             len(user_vocab), len(item_vocab), n_shards)
+        _, V = train_als(mesh, data, ALSParams(
+            rank=self.params.rank, num_iterations=self.params.num_iterations,
+            reg=self.params.reg, alpha=self.params.alpha,
+            implicit_prefs=True, seed=self.params.seed))
+        norms = np.linalg.norm(V, axis=1, keepdims=True)
+        V = V / np.where(norms == 0, 1.0, norms)
+        item_meta = {}
+        for iid, item in pd.items.items():
+            idx = vocab_index(item_vocab, iid)
+            if idx is not None:
+                item_meta[idx] = item
+        return SimilarityModel(item_vocab=item_vocab, V=V, items=item_meta)
+
+    def predict(self, model: SimilarityModel, query: Query) -> PredictedResult:
+        query_idx = {i for i in (model.item_index(x) for x in query.items)
+                     if i is not None}
+        if not query_idx:
+            return PredictedResult(item_scores=[])
+        # summed cosine: V is row-normalized so scores = V @ sum(q_vecs)
+        qsum = model.V[sorted(query_idx)].sum(axis=0)
+        scores = model.V @ qsum
+        return _score_and_filter(model, scores, query, query_idx)
+
+
+class LikeAlgorithm(ALSAlgorithm):
+    """LikeAlgorithm.scala parity: latest like/dislike per (user, item),
+    like=+1, dislike=-1, into implicit ALS."""
+
+    def _ratings(self, pd: PreparedData):
+        latest: Dict[Tuple[str, str], LikeEvent] = {}
+        for e in pd.like_events:
+            key = (e.user, e.item)
+            if key not in latest or e.t > latest[key].t:
+                latest[key] = e
+        return [(u, i, 1.0 if e.like else -1.0)
+                for (u, i), e in latest.items()]
+
+
+@dataclasses.dataclass
+class CooccurrenceAlgorithmParams(Params):
+    n: int = 20
+
+
+@dataclasses.dataclass
+class CooccurrenceEngineModel:
+    model: CooccurrenceModel
+    items: Dict[int, Item]
+
+
+class CooccurrenceAlgorithm(Algorithm):
+    params_class = CooccurrenceAlgorithmParams
+
+    def __init__(self, params: Optional[CooccurrenceAlgorithmParams] = None):
+        self.params = params or CooccurrenceAlgorithmParams()
+
+    def train(self, ctx, pd: PreparedData) -> CooccurrenceEngineModel:
+        if not pd.view_events:
+            raise ValueError("view events cannot be empty")
+        users = np.asarray([v.user for v in pd.view_events], dtype=object)
+        items = np.asarray([v.item for v in pd.view_events], dtype=object)
+        user_vocab, user_codes = assign_indices(users)
+        item_vocab, item_codes = assign_indices(items)
+        top = train_cooccurrence(user_codes, item_codes,
+                                 len(user_vocab), len(item_vocab),
+                                 self.params.n)
+        model = CooccurrenceModel(item_vocab=item_vocab,
+                                  top_cooccurrences=top)
+        item_meta = {}
+        for iid, item in pd.items.items():
+            idx = model.item_index(iid)
+            if idx is not None:
+                item_meta[idx] = item
+        return CooccurrenceEngineModel(model=model, items=item_meta)
+
+    def predict(self, m: CooccurrenceEngineModel, query: Query
+                ) -> PredictedResult:
+        query_idx = {i for i in (m.model.item_index(x) for x in query.items)
+                     if i is not None}
+        counts: Dict[int, int] = {}
+        for q in query_idx:
+            for cand, c in m.model.top_cooccurrences.get(q, []):
+                counts[cand] = counts.get(cand, 0) + c
+        white = None
+        if query.white_list is not None:
+            white = {i for i in (m.model.item_index(x)
+                                 for x in query.white_list) if i is not None}
+        black = set()
+        if query.black_list is not None:
+            black = {i for i in (m.model.item_index(x)
+                                 for x in query.black_list) if i is not None}
+        out = []
+        for cand, c in sorted(counts.items(), key=lambda x: -x[1]):
+            if not _candidate_ok(cand, m.items, query_idx, query, white, black):
+                continue
+            out.append(ItemScore(item=str(m.model.item_vocab[cand]),
+                                 score=float(c)))
+            if len(out) >= query.num:
+                break
+        return PredictedResult(item_scores=out)
+
+
+class SimilarProductServing(FirstServing):
+    pass
+
+
+def engine() -> Engine:
+    """Engine.scala factory parity (multi-algo engine)."""
+    return Engine(
+        data_source_classes=SimilarProductDataSource,
+        preparator_classes=SimilarProductPreparator,
+        algorithm_classes={"als": ALSAlgorithm,
+                           "cooccurrence": CooccurrenceAlgorithm,
+                           "likealgo": LikeAlgorithm},
+        serving_classes=SimilarProductServing,
+    )
+
+
+def default_engine_params(app_name: str,
+                          algorithms: Sequence[str] = ("als",)) -> EngineParams:
+    defaults = {"als": ALSAlgorithmParams(),
+                "cooccurrence": CooccurrenceAlgorithmParams(),
+                "likealgo": ALSAlgorithmParams()}
+    return EngineParams(
+        data_source_params=DataSourceParams(app_name=app_name),
+        algorithm_params_list=[(a, defaults[a]) for a in algorithms],
+    )

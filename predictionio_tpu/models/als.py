@@ -32,8 +32,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.bimap import vocab_index
 from predictionio_tpu.ops.linalg import batched_spd_solve
-from predictionio_tpu.ops.segment import segment_gram_rhs
+from predictionio_tpu.ops.segment import segment_count, segment_gram_rhs
 
 
 @dataclasses.dataclass
@@ -147,19 +148,22 @@ def _half_sweep(opposite: jax.Array, coo_tgt, coo_seg, coo_val, coo_w,
     """Solve this side's factors for one shard. opposite is the full
     (replicated) opposite-side factor matrix."""
     if params.implicit_prefs:
-        # Hu-Koren: A_s = V^T V + sum alpha*r f f^T + lam I ; b_s = sum c f
-        gram_all = opposite.T @ opposite                      # [K, K] MXU
-        gram, rhs, cnt = segment_gram_rhs(
-            opposite, coo_tgt, coo_seg,
-            values=jnp.ones_like(coo_val), weights=coo_w * (1 + params.alpha * coo_val),
+        # Hu-Koren-Volinsky: preference p = [r > 0], confidence
+        # c = 1 + alpha * |r| (negative r = confident dislike, the
+        # similarproduct LikeAlgorithm convention).
+        # A_s = V^T V + sum (c-1) f f^T + lam I ; b_s = sum c p f
+        # One segment pass: gram weights (c-1); rhs values c*p/(c-1) so that
+        # value * weight = c * p exactly.
+        cm1 = params.alpha * jnp.abs(coo_val)            # c - 1
+        p = jnp.where(coo_val > 0, 1.0, 0.0)
+        vals = jnp.where(cm1 > 0,
+                         (1.0 + cm1) * p / jnp.maximum(cm1, 1e-12), 0.0)
+        gram_all = opposite.T @ opposite                 # [K, K] MXU
+        gram, rhs, _ = segment_gram_rhs(
+            opposite, coo_tgt, coo_seg, values=vals, weights=coo_w * cm1,
             num_segments=seg_per_shard, chunk_size=chunk_size)
-        # subtract the p=1,c=1 part double-counted? No: we accumulate
-        # c * f f^T; the Hu-Koren decomposition uses V^T V + (c-1) f f^T.
-        gram_c1, _, _ = segment_gram_rhs(
-            opposite, coo_tgt, coo_seg,
-            values=jnp.zeros_like(coo_val), weights=coo_w,
-            num_segments=seg_per_shard, chunk_size=chunk_size)
-        A = gram_all[None, :, :] + (gram - gram_c1)
+        cnt = segment_count(coo_seg, coo_w, seg_per_shard)
+        A = gram_all[None, :, :] + gram
         lam = params.reg * jnp.where(params.weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
         A = A + lam[:, None, None] * jnp.eye(opposite.shape[1], dtype=A.dtype)
         return batched_spd_solve(A, rhs)
@@ -268,16 +272,10 @@ class ALSModel:
     V: np.ndarray            # [n_items, K]
 
     def user_index(self, user_id: str) -> Optional[int]:
-        i = np.searchsorted(self.user_vocab, user_id)
-        if i < len(self.user_vocab) and self.user_vocab[i] == user_id:
-            return int(i)
-        return None
+        return vocab_index(self.user_vocab, user_id)
 
     def item_index(self, item_id: str) -> Optional[int]:
-        i = np.searchsorted(self.item_vocab, item_id)
-        if i < len(self.item_vocab) and self.item_vocab[i] == item_id:
-            return int(i)
-        return None
+        return vocab_index(self.item_vocab, item_id)
 
     def predict_rating(self, user_id: str, item_id: str) -> Optional[float]:
         ui, ii = self.user_index(user_id), self.item_index(item_id)

@@ -1,0 +1,146 @@
+"""Item cooccurrence counting for similar-product recommendation.
+
+TPU-native replacement for the reference CooccurrenceAlgorithm's Spark
+self-join (examples/scala-parallel-similarproduct/multi-events-multi-algos/
+src/main/scala/CooccurrenceAlgorithm.scala:71-105): distinct (user, item)
+pairs -> per-item-pair counts -> top-N per item.
+
+Design: counting cooccurrences is C = A^T A for the binary user x item
+interaction matrix. When the dense A fits a memory budget the count becomes
+ONE bf16-friendly MXU matmul (ML-1M: [6040, 3706] -> 8e10 MACs, milliseconds
+on a v5e chip, vs a shuffle-heavy Spark join). Larger item spaces fall back
+to vectorized host counting over sorted per-user pair enumeration (the same
+work the Spark join materializes, without the shuffle).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import vocab_index
+
+#: max dense A entries before falling back to host counting (f32 ~2GB)
+DENSE_BUDGET = 500_000_000
+
+
+def distinct_pairs(user_idx: np.ndarray, item_idx: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """De-duplicate (user, item) events (the reference's .distinct())."""
+    combined = user_idx.astype(np.int64) * (item_idx.max() + 1 if item_idx.size else 1) \
+        + item_idx.astype(np.int64)
+    _, keep = np.unique(combined, return_index=True)
+    return user_idx[keep], item_idx[keep]
+
+
+def cooccurrence_counts_dense(user_idx: np.ndarray, item_idx: np.ndarray,
+                              n_users: int, n_items: int) -> np.ndarray:
+    """C = A^T A on device — the MXU path. Returns [n_items, n_items] with
+    the diagonal zeroed."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def count(u, i):
+        a = jnp.zeros((n_users, n_items), jnp.float32).at[u, i].set(1.0)
+        c = a.T @ a
+        return c * (1.0 - jnp.eye(n_items, dtype=jnp.float32))
+
+    return np.asarray(jax.device_get(count(jnp.asarray(user_idx),
+                                           jnp.asarray(item_idx))))
+
+
+def cooccurrence_topn_host(user_idx: np.ndarray, item_idx: np.ndarray,
+                           n_items: int, n: int) -> Dict[int, List[Tuple[int, int]]]:
+    """Host fallback: enumerate per-user item pairs vectorized, count, top-N."""
+    order = np.argsort(user_idx, kind="stable")
+    u_s, i_s = user_idx[order], item_idx[order]
+    # pair enumeration per user: for each user's item list, all i1 < i2 combos
+    pairs: Dict[Tuple[int, int], int] = {}
+    start = 0
+    while start < len(u_s):
+        end = start
+        while end < len(u_s) and u_s[end] == u_s[start]:
+            end += 1
+        items = np.sort(i_s[start:end])
+        if len(items) > 1:
+            i1, i2 = np.triu_indices(len(items), k=1)
+            for a, b in zip(items[i1], items[i2]):
+                if a != b:
+                    pairs[(int(a), int(b))] = pairs.get((int(a), int(b)), 0) + 1
+        start = end
+    top: Dict[int, List[Tuple[int, int]]] = {}
+    for (a, b), c in pairs.items():
+        top.setdefault(a, []).append((b, c))
+        top.setdefault(b, []).append((a, c))
+    return {k: sorted(v, key=lambda x: -x[1])[:n] for k, v in top.items()}
+
+
+def train_cooccurrence(user_idx: np.ndarray, item_idx: np.ndarray,
+                       n_users: int, n_items: int, n: int
+                       ) -> Dict[int, List[Tuple[int, int]]]:
+    """Top-N cooccurring (item, count) per item (trainCooccurrence parity)."""
+    if len(user_idx) == 0:
+        return {}
+    user_idx, item_idx = distinct_pairs(user_idx, item_idx)
+    # both the [n_users, n_items] interaction matrix AND the
+    # [n_items, n_items] count matrix must fit the budget
+    if max(n_users * n_items, n_items * n_items) <= DENSE_BUDGET:
+        counts = cooccurrence_counts_dense(user_idx, item_idx, n_users, n_items)
+        top: Dict[int, List[Tuple[int, int]]] = {}
+        k = min(n, max(n_items - 1, 1))
+        idx = np.argpartition(-counts, kth=k - 1, axis=1)[:, :k]
+        for item in range(n_items):
+            cands = [(int(j), int(counts[item, j])) for j in idx[item]
+                     if counts[item, j] > 0]
+            if cands:
+                top[item] = sorted(cands, key=lambda x: -x[1])[:n]
+        return top
+    return cooccurrence_topn_host(user_idx, item_idx, n_items, n)
+
+
+@dataclasses.dataclass
+class CooccurrenceModel:
+    """CooccurrenceModel parity: top-N lists + id maps."""
+
+    item_vocab: np.ndarray                      # sorted distinct item ids
+    top_cooccurrences: Dict[int, List[Tuple[int, int]]]
+
+    def item_index(self, item_id: str) -> Optional[int]:
+        return vocab_index(self.item_vocab, item_id)
+
+    def similar(self, item_ids: List[str], num: int,
+                exclude_query: bool = True,
+                white_list: Optional[List[str]] = None,
+                black_list: Optional[List[str]] = None
+                ) -> List[Tuple[str, float]]:
+        """Combine the query items' top lists (predict parity: sum counts
+        per candidate, filter, sort desc)."""
+        query_idx = {i for i in (self.item_index(x) for x in item_ids)
+                     if i is not None}
+        white = None
+        if white_list is not None:
+            white = {i for i in (self.item_index(x) for x in white_list)
+                     if i is not None}
+        black = set()
+        if black_list is not None:
+            black = {i for i in (self.item_index(x) for x in black_list)
+                     if i is not None}
+        counts: Dict[int, int] = {}
+        for q in query_idx:
+            for cand, c in self.top_cooccurrences.get(q, []):
+                counts[cand] = counts.get(cand, 0) + c
+        out = []
+        for cand, c in sorted(counts.items(), key=lambda x: -x[1]):
+            if exclude_query and cand in query_idx:
+                continue
+            if white is not None and cand not in white:
+                continue
+            if cand in black:
+                continue
+            out.append((str(self.item_vocab[cand]), float(c)))
+            if len(out) >= num:
+                break
+        return out

@@ -1,0 +1,82 @@
+"""Softmax / logistic regression via optax.
+
+The MLlib LogisticRegression analog used by the classification template
+variants (SURVEY.md section 2.8). Full-batch jitted gradient descent with
+optax.adam: for template-scale data the whole dataset lives on device and
+each step is one fused MXU matmul + softmax-CE; lax.scan drives the epochs
+inside a single compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LogRegParams:
+    iterations: int = 200
+    learning_rate: float = 0.1
+    reg: float = 1e-4
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class LogRegModel:
+    label_vocab: np.ndarray
+    W: np.ndarray            # [F, L]
+    b: np.ndarray            # [L]
+
+    def predict_scores(self, X: np.ndarray) -> np.ndarray:
+        return np.atleast_2d(X) @ self.W + self.b
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return self.label_vocab[np.argmax(self.predict_scores(X), axis=1)]
+
+
+def train_logreg(X: np.ndarray, labels: Sequence[str],
+                 params: LogRegParams = LogRegParams()) -> LogRegModel:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    labels = np.asarray(labels, dtype=object)
+    label_vocab, y = np.unique(labels, return_inverse=True)
+    n_features, n_labels = X.shape[1], len(label_vocab)
+
+    Xd = jnp.asarray(X, jnp.float32)
+    yd = jnp.asarray(y, jnp.int32)
+
+    def loss_fn(w_b):
+        W, b = w_b
+        logits = Xd @ W + b
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, yd).mean()
+        return ce + params.reg * (W * W).sum()
+
+    opt = optax.adam(params.learning_rate)
+    key = jax.random.PRNGKey(params.seed)
+    W0 = jax.random.normal(key, (n_features, n_labels), jnp.float32) * 0.01
+    b0 = jnp.zeros((n_labels,), jnp.float32)
+
+    @jax.jit
+    def fit(W, b):
+        state = opt.init((W, b))
+
+        def step(carry, _):
+            (W, b), state = carry
+            grads = jax.grad(loss_fn)((W, b))
+            updates, state = opt.update(grads, state)
+            W, b = optax.apply_updates((W, b), updates)
+            return ((W, b), state), None
+
+        ((W, b), _), _ = jax.lax.scan(
+            step, ((W, b), state), None, length=params.iterations)
+        return W, b
+
+    W, b = fit(W0, b0)
+    return LogRegModel(
+        label_vocab=label_vocab,
+        W=np.asarray(jax.device_get(W)),
+        b=np.asarray(jax.device_get(b)))

@@ -1,0 +1,146 @@
+"""Naive Bayes classifiers.
+
+Two variants, replacing the reference's two NB paths:
+  * CategoricalNaiveBayes — parity with e2's string-feature NB
+    (e2/.../engine/CategoricalNaiveBayes.scala:23-172): per-position
+    categorical features, log prior + per-feature log likelihoods, optional
+    default-likelihood function for unseen values. Counting is vectorized
+    (np.unique + bincount) instead of combineByKey.
+  * MultinomialNB — the MLlib NaiveBayes analog used by the classification
+    template (examples/scala-parallel-classification/add-algorithm/src/main/
+    scala/NaiveBayesAlgorithm.scala:35-56): numeric count-vector features;
+    prediction is one MXU matmul X @ logP^T + prior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Categorical NB (e2 parity)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LabeledPoint:
+    """e2 LabeledPoint: (label, string features per position)."""
+
+    label: str
+    features: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class CategoricalNaiveBayesModel:
+    """priors/likelihoods structure parity (CategoricalNaiveBayes.scala:87)."""
+
+    priors: Dict[str, float]                           # label -> log prior
+    likelihoods: Dict[str, List[Dict[str, float]]]     # label -> per-position
+
+    def log_score(self, point: LabeledPoint,
+                  default_likelihood: Callable[[Sequence[float]], float]
+                  = lambda ls: float("-inf")) -> Optional[float]:
+        if point.label not in self.priors:
+            return None
+        return self._log_score(point.label, point.features,
+                               default_likelihood)
+
+    def _log_score(self, label: str, features: Sequence[str],
+                   default_likelihood) -> float:
+        ll = self.likelihoods[label]
+        total = self.priors[label]
+        for feature, position in zip(features, ll):
+            total += position.get(
+                feature, default_likelihood(list(position.values())))
+        return total
+
+    def predict(self, features: Sequence[str]) -> str:
+        scored = [(label, self._log_score(label, features,
+                                          lambda ls: float("-inf")))
+                  for label in self.priors]
+        return max(scored, key=lambda x: x[1])[0]
+
+
+def train_categorical_nb(points: Sequence[LabeledPoint]
+                         ) -> CategoricalNaiveBayesModel:
+    """CategoricalNaiveBayes.train parity, vectorized."""
+    if not points:
+        raise ValueError("no training points")
+    n_positions = len(points[0].features)
+    labels = np.asarray([p.label for p in points], dtype=object)
+    label_vocab, label_codes = np.unique(labels, return_inverse=True)
+    label_counts = np.bincount(label_codes, minlength=len(label_vocab))
+    total = float(len(points))
+
+    priors = {str(lab): math.log(label_counts[i] / total)
+              for i, lab in enumerate(label_vocab)}
+    likelihoods: Dict[str, List[Dict[str, float]]] = {
+        str(lab): [] for lab in label_vocab}
+
+    for pos in range(n_positions):
+        feats = np.asarray([p.features[pos] for p in points], dtype=object)
+        feat_vocab, feat_codes = np.unique(feats, return_inverse=True)
+        # joint counts [n_labels, n_feat_values] in one bincount
+        joint = np.bincount(
+            label_codes * len(feat_vocab) + feat_codes,
+            minlength=len(label_vocab) * len(feat_vocab),
+        ).reshape(len(label_vocab), len(feat_vocab))
+        for li, lab in enumerate(label_vocab):
+            position_map = {
+                str(feat_vocab[fi]): math.log(joint[li, fi] / label_counts[li])
+                for fi in range(len(feat_vocab)) if joint[li, fi] > 0}
+            likelihoods[str(lab)].append(position_map)
+
+    return CategoricalNaiveBayesModel(priors=priors, likelihoods=likelihoods)
+
+
+# ---------------------------------------------------------------------------
+# Multinomial NB (MLlib analog)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MultinomialNBModel:
+    """label vocab + log priors [L] + log feature probs [L, F]."""
+
+    label_vocab: np.ndarray
+    log_prior: np.ndarray
+    log_prob: np.ndarray
+
+    def predict_scores(self, X: np.ndarray) -> np.ndarray:
+        """[N, F] -> [N, L] joint log-likelihood (one MXU matmul)."""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score(x, lp, pri):
+            return x @ lp.T + pri[None, :]
+
+        return np.asarray(jax.device_get(score(
+            jnp.asarray(X, jnp.float32), jnp.asarray(self.log_prob),
+            jnp.asarray(self.log_prior))))
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        scores = self.predict_scores(np.atleast_2d(X))
+        return self.label_vocab[np.argmax(scores, axis=1)]
+
+
+def train_multinomial_nb(X: np.ndarray, labels: Sequence[str],
+                         smoothing: float = 1.0) -> MultinomialNBModel:
+    """MLlib NaiveBayes.train parity (lambda smoothing)."""
+    labels = np.asarray(labels, dtype=object)
+    label_vocab, label_codes = np.unique(labels, return_inverse=True)
+    n_labels = len(label_vocab)
+    n_features = X.shape[1]
+    counts = np.zeros((n_labels, n_features), np.float64)
+    np.add.at(counts, label_codes, X)
+    label_counts = np.bincount(label_codes, minlength=n_labels)
+    log_prior = np.log(label_counts / label_counts.sum())
+    smoothed = counts + smoothing
+    log_prob = np.log(smoothed / smoothed.sum(axis=1, keepdims=True))
+    return MultinomialNBModel(
+        label_vocab=label_vocab,
+        log_prior=log_prior.astype(np.float32),
+        log_prob=log_prob.astype(np.float32))

@@ -37,6 +37,16 @@ class WorkflowParams:
     runtime_conf: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
+def mesh_of(ctx):
+    """The mesh of a workflow context, or a fresh default mesh when the
+    caller passed a bare context (tests, embedded use). Shared by every
+    algorithm that trains on the mesh."""
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None:
+        mesh = WorkflowContext.create(mode="Training").mesh
+    return mesh
+
+
 class WorkflowContext:
     """Holds the device mesh + app metadata for one workflow run."""
 

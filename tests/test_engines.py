@@ -1,0 +1,281 @@
+"""Engine templates: similarproduct, classification, ecommerce
+(mirrors the reference template integration expectations)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.storage import App, Storage
+from predictionio_tpu.workflow import run_train
+from predictionio_tpu.workflow.train import load_for_deploy
+
+
+@pytest.fixture()
+def backend(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "t.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    from predictionio_tpu.data.eventstore import clear_cache
+    clear_cache()
+    yield Storage
+    Storage.reset()
+    clear_cache()
+
+
+def make_app(backend, name):
+    app_id = backend.get_meta_data_apps().insert(App(id=0, name=name))
+    backend.get_events().init_channel(app_id)
+    return app_id
+
+
+# -- similarproduct ----------------------------------------------------------
+
+@pytest.fixture()
+def similar_app(backend):
+    app_id = make_app(backend, "SimApp")
+    store = backend.get_events()
+    events = []
+    for u in range(20):
+        events.append(Event(event="$set", entity_type="user",
+                            entity_id=f"u{u}"))
+    for it in range(12):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=f"i{it}",
+            properties=DataMap({"categories": ["even" if it % 2 == 0
+                                               else "odd"]})))
+    rng = np.random.default_rng(3)
+    for u in range(20):
+        group = u % 2
+        for it in range(12):
+            if it % 2 == group and rng.random() < 0.8:
+                events.append(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{it}"))
+            if it % 2 == group and rng.random() < 0.3:
+                events.append(Event(
+                    event="like", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{it}"))
+    store.insert_batch(events, app_id)
+    return "SimApp"
+
+
+def test_similarproduct_als(similar_app):
+    from predictionio_tpu.engines.similarproduct import (
+        Query, default_engine_params, engine,
+    )
+
+    eng = engine()
+    ep = default_engine_params(similar_app, algorithms=("als",))
+    instance = run_train(
+        eng, ep, engine_factory="predictionio_tpu.engines.similarproduct:engine")
+    result, ctx = load_for_deploy(eng, instance)
+    algo, model = result.algorithms[0], result.models[0]
+
+    pred = algo.predict(model, Query(items=("i0",), num=4))
+    assert len(pred.item_scores) == 4
+    # similar items to an even item are mostly even
+    even = sum(int(s.item[1:]) % 2 == 0 for s in pred.item_scores)
+    assert even >= 3
+    assert "i0" not in [s.item for s in pred.item_scores]
+
+    # category filter restricts candidates
+    pred = algo.predict(model, Query(items=("i0",), num=6,
+                                     categories=("odd",)))
+    assert all(int(s.item[1:]) % 2 == 1 for s in pred.item_scores)
+
+    # black list removes an item
+    pred = algo.predict(model, Query(items=("i0",), num=4,
+                                     black_list=("i2",)))
+    assert "i2" not in [s.item for s in pred.item_scores]
+
+    # unknown query items -> empty result
+    assert algo.predict(model, Query(items=("nope",), num=3)).item_scores == []
+
+
+def test_similarproduct_cooccurrence_and_multi_algo(similar_app):
+    from predictionio_tpu.engines.similarproduct import (
+        Query, default_engine_params, engine,
+    )
+
+    eng = engine()
+    ep = default_engine_params(similar_app,
+                               algorithms=("als", "cooccurrence", "likealgo"))
+    instance = run_train(
+        eng, ep, engine_factory="predictionio_tpu.engines.similarproduct:engine")
+    result, ctx = load_for_deploy(eng, instance)
+    assert len(result.models) == 3
+    cooc_algo, cooc_model = result.algorithms[1], result.models[1]
+    pred = cooc_algo.predict(cooc_model, Query(items=("i0",), num=3))
+    assert pred.item_scores
+    assert all(int(s.item[1:]) % 2 == 0 for s in pred.item_scores)
+    # serving returns first algorithm's prediction
+    served = result.serving.serve(
+        Query(items=("i0",), num=3),
+        [a.predict(m, Query(items=("i0",), num=3))
+         for a, m in zip(result.algorithms, result.models)])
+    assert served.item_scores
+
+
+# -- classification ----------------------------------------------------------
+
+@pytest.fixture()
+def classification_app(backend):
+    app_id = make_app(backend, "ClsApp")
+    store = backend.get_events()
+    rng = np.random.default_rng(5)
+    events = []
+    for i in range(150):
+        attr0 = float(rng.integers(0, 8))
+        attr1 = float(rng.integers(0, 8))
+        attr2 = float(rng.integers(0, 4))
+        plan = 1.0 if attr0 > attr1 else 0.0
+        events.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{i}",
+            properties=DataMap({"plan": plan, "attr0": attr0,
+                                "attr1": attr1, "attr2": attr2})))
+    store.insert_batch(events, app_id)
+    return "ClsApp"
+
+
+def test_classification_naive_bayes(classification_app):
+    from predictionio_tpu.engines.classification import (
+        Query, default_engine_params, engine,
+    )
+
+    eng = engine()
+    ep = default_engine_params(classification_app, algorithm="naive")
+    instance = run_train(
+        eng, ep, engine_factory="predictionio_tpu.engines.classification:engine")
+    result, ctx = load_for_deploy(eng, instance)
+    algo, model = result.algorithms[0], result.models[0]
+    pred = algo.predict(model, Query(attr0=7.0, attr1=0.0, attr2=1.0))
+    assert pred.label == 1.0
+    pred = algo.predict(model, Query(attr0=0.0, attr1=7.0, attr2=1.0))
+    assert pred.label == 0.0
+
+
+def test_classification_logreg_and_eval(classification_app):
+    from predictionio_tpu.core import Evaluation
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.engines.classification import (
+        Accuracy, DataSourceParams, LogisticRegressionParams,
+        NaiveBayesParams, engine,
+    )
+    from predictionio_tpu.workflow import run_evaluation
+
+    eng = engine()
+    ds = DataSourceParams(app_name=classification_app, eval_k=3)
+    params = [
+        EngineParams(data_source_params=ds,
+                     algorithm_params_list=[("naive", NaiveBayesParams())]),
+        EngineParams(data_source_params=ds,
+                     algorithm_params_list=[
+                         ("logreg", LogisticRegressionParams(iterations=300))]),
+    ]
+    ev = Evaluation(engine=eng, metric=Accuracy(), output_path=None)
+    result = run_evaluation(ev, params)
+    # logreg should fit this linearly-separable data well
+    assert result.engine_params_scores[1][1] > 0.85
+    assert result.best_score > 0.6
+
+
+# -- ecommerce ---------------------------------------------------------------
+
+@pytest.fixture()
+def ecomm_app(backend):
+    app_id = make_app(backend, "EcommApp")
+    store = backend.get_events()
+    rng = np.random.default_rng(9)
+    events = []
+    for it in range(10):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=f"i{it}",
+            properties=DataMap({"categories": ["c1" if it < 5 else "c2"]})))
+    for u in range(15):
+        group = u % 2
+        for it in range(10):
+            if it % 2 == group and rng.random() < 0.8:
+                events.append(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{it}"))
+            if it % 2 == group and rng.random() < 0.4:
+                events.append(Event(
+                    event="buy", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{it}"))
+    store.insert_batch(events, app_id)
+    return "EcommApp"
+
+
+def test_ecommerce_predict_paths(ecomm_app):
+    from predictionio_tpu.engines.ecommerce import (
+        Query, default_engine_params, engine,
+    )
+
+    eng = engine()
+    ep = default_engine_params(ecomm_app)
+    instance = run_train(
+        eng, ep, engine_factory="predictionio_tpu.engines.ecommerce:engine")
+    result, ctx = load_for_deploy(eng, instance)
+    algo, model = result.algorithms[0], result.models[0]
+
+    # known user: factor scoring
+    pred = algo.predict(model, Query(user="u0", num=4))
+    assert len(pred.item_scores) == 4
+    even = sum(int(s.item[1:]) % 2 == 0 for s in pred.item_scores)
+    assert even >= 3
+
+    # unknown user with no recent events: popularity fallback
+    pred = algo.predict(model, Query(user="stranger", num=3))
+    assert len(pred.item_scores) == 3
+    assert pred.item_scores[0].score >= pred.item_scores[-1].score
+
+    # category filter
+    pred = algo.predict(model, Query(user="u0", num=5, categories=("c1",)))
+    assert all(int(s.item[1:]) < 5 for s in pred.item_scores)
+
+    # white list
+    pred = algo.predict(model, Query(user="u0", num=5,
+                                     white_list=("i0", "i2")))
+    assert {s.item for s in pred.item_scores} <= {"i0", "i2"}
+
+
+def test_ecommerce_unseen_only_and_unavailable(backend, ecomm_app):
+    from predictionio_tpu.engines.ecommerce import (
+        ECommAlgorithmParams, Query, engine,
+    )
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.engines.ecommerce import DataSourceParams
+
+    # mark i0 unavailable via constraint entity
+    from predictionio_tpu.data.eventstore import resolve_app
+    app_id, _ = resolve_app(ecomm_app)
+    backend.get_events().insert(Event(
+        event="$set", entity_type="constraint",
+        entity_id="unavailableItems",
+        properties=DataMap({"items": ["i0"]})), app_id)
+
+    eng = engine()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(app_name=ecomm_app),
+        algorithm_params_list=[("ecomm", ECommAlgorithmParams(
+            app_name=ecomm_app, unseen_only=True))])
+    instance = run_train(
+        eng, ep, engine_factory="predictionio_tpu.engines.ecommerce:engine")
+    result, ctx = load_for_deploy(eng, instance)
+    algo, model = result.algorithms[0], result.models[0]
+
+    pred = algo.predict(model, Query(user="u0", num=10))
+    items = [s.item for s in pred.item_scores]
+    assert "i0" not in items  # unavailable
+    # u0's seen items are excluded
+    seen = {e.target_entity_id for e in backend.get_events().find(
+        app_id, entity_type="user", entity_id="u0",
+        event_names=["view", "buy"])}
+    assert not (set(items) & seen)

@@ -1,0 +1,178 @@
+"""Model kernels: cooccurrence, NaiveBayes (both variants), LogReg,
+MarkovChain, BinaryVectorizer (mirrors reference e2 test coverage)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.cooccurrence import (
+    CooccurrenceModel, cooccurrence_topn_host, distinct_pairs,
+    train_cooccurrence,
+)
+from predictionio_tpu.models.logreg import LogRegParams, train_logreg
+from predictionio_tpu.models.markov_chain import train_markov_chain
+from predictionio_tpu.models.naive_bayes import (
+    LabeledPoint, train_categorical_nb, train_multinomial_nb,
+)
+from predictionio_tpu.models.vectorizer import BinaryVectorizer, split_data
+
+
+# -- cooccurrence ------------------------------------------------------------
+
+def test_distinct_pairs():
+    u = np.array([0, 0, 1, 0], np.int32)
+    i = np.array([1, 1, 1, 2], np.int32)
+    du, di = distinct_pairs(u, i)
+    assert len(du) == 3  # (0,1) deduped
+
+
+def test_cooccurrence_counts():
+    # users 0,1 both saw items {0,1}; user 2 saw {1,2}
+    u = np.array([0, 0, 1, 1, 2, 2], np.int32)
+    i = np.array([0, 1, 0, 1, 1, 2], np.int32)
+    top = train_cooccurrence(u, i, n_users=3, n_items=3, n=5)
+    assert dict(top[0]) == {1: 2}
+    assert dict(top[1]) == {0: 2, 2: 1}
+    assert top[1][0] == (0, 2)  # sorted by count desc
+    assert dict(top[2]) == {1: 1}
+
+
+def test_cooccurrence_dense_matches_host():
+    rng = np.random.default_rng(0)
+    u = rng.integers(0, 20, 200).astype(np.int32)
+    i = rng.integers(0, 15, 200).astype(np.int32)
+    dense = train_cooccurrence(u, i, 20, 15, n=5)
+    du, di = distinct_pairs(u, i)
+    host = cooccurrence_topn_host(du, di, 15, n=5)
+    for item in range(15):
+        d = dict(dense.get(item, []))
+        h = dict(host.get(item, []))
+        # top-5 sets may break count ties differently; the count multiset
+        # must agree, and shared candidates must have identical counts
+        assert sorted(d.values()) == sorted(h.values())
+        for cand in set(d) & set(h):
+            assert d[cand] == h[cand]
+
+
+def test_cooccurrence_model_similar():
+    model = CooccurrenceModel(
+        item_vocab=np.array(["a", "b", "c"], dtype=object),
+        top_cooccurrences={0: [(1, 5), (2, 2)], 1: [(0, 5)], 2: [(0, 2)]})
+    out = model.similar(["a"], num=2)
+    assert out == [("b", 5.0), ("c", 2.0)]
+    # query item excluded; black list respected
+    out = model.similar(["a", "b"], num=3)
+    assert all(i not in ("a", "b") for i, _ in out)
+    out = model.similar(["a"], num=2, black_list=["b"])
+    assert out == [("c", 2.0)]
+    out = model.similar(["a"], num=2, white_list=["b"])
+    assert out == [("b", 5.0)]
+    assert model.similar(["zzz"], num=2) == []
+
+
+# -- categorical NB (e2 parity fixture) --------------------------------------
+
+@pytest.fixture
+def nb_points():
+    # e2 NaiveBayesFixture-style: label from first feature mostly
+    return [
+        LabeledPoint("spam", ("free", "money", "now")),
+        LabeledPoint("spam", ("free", "cash", "now")),
+        LabeledPoint("ham", ("meeting", "money", "tomorrow")),
+        LabeledPoint("ham", ("meeting", "agenda", "tomorrow")),
+    ]
+
+
+def test_categorical_nb_train_structure(nb_points):
+    model = train_categorical_nb(nb_points)
+    assert set(model.priors) == {"spam", "ham"}
+    assert model.priors["spam"] == pytest.approx(np.log(0.5))
+    # position 0 'free' appears in 2/2 spam
+    assert model.likelihoods["spam"][0]["free"] == pytest.approx(0.0)
+    assert "free" not in model.likelihoods["ham"][0]
+
+
+def test_categorical_nb_predict(nb_points):
+    model = train_categorical_nb(nb_points)
+    assert model.predict(("free", "money", "now")) == "spam"
+    assert model.predict(("meeting", "agenda", "tomorrow")) == "ham"
+
+
+def test_categorical_nb_log_score(nb_points):
+    model = train_categorical_nb(nb_points)
+    s = model.log_score(LabeledPoint("spam", ("free", "money", "now")))
+    assert s == pytest.approx(np.log(0.5) + 0.0 + np.log(0.5) + 0.0)
+    # unknown label -> None
+    assert model.log_score(LabeledPoint("eggs", ("free",))) is None
+    # unseen feature -> -inf by default, custom default applies
+    assert model.log_score(
+        LabeledPoint("spam", ("UNSEEN", "money", "now"))) == float("-inf")
+    s = model.log_score(LabeledPoint("spam", ("UNSEEN", "money", "now")),
+                        default_likelihood=lambda ls: min(ls) - 1)
+    assert np.isfinite(s)
+
+
+# -- multinomial NB / logreg -------------------------------------------------
+
+def classification_data(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.poisson(2.0, size=(n, 3)).astype(np.float32)
+    labels = np.where(X[:, 0] > X[:, 1], "1.0", "0.0")
+    return X, [str(l) for l in labels]
+
+
+def test_multinomial_nb_learns():
+    X, y = classification_data()
+    model = train_multinomial_nb(X, y)
+    pred = model.predict(X)
+    acc = (pred == np.asarray(y, dtype=object)).mean()
+    assert acc > 0.75
+    assert set(model.label_vocab) == {"0.0", "1.0"}
+
+
+def test_logreg_learns():
+    X, y = classification_data()
+    model = train_logreg(X, y, LogRegParams(iterations=300))
+    acc = (model.predict(X) == np.asarray(y, dtype=object)).mean()
+    assert acc > 0.9
+
+
+# -- markov chain ------------------------------------------------------------
+
+def test_markov_chain():
+    src = np.array([0, 0, 0, 1, 1, 2])
+    dst = np.array([1, 1, 2, 0, 2, 0])
+    cnt = np.ones(6)
+    model = train_markov_chain(src, dst, cnt, n_states=3, top_n=2)
+    # row 0: 1 with 2/3, 2 with 1/3
+    assert model.predict(0)[0] == (1, pytest.approx(2 / 3))
+    assert model.predict(0)[1] == (2, pytest.approx(1 / 3))
+    assert model.predict(1)[0][1] == pytest.approx(0.5)
+    assert model.predict(2) == [(0, 1.0)]
+    # top_n truncates
+    m1 = train_markov_chain(src, dst, cnt, n_states=3, top_n=1)
+    assert len(m1.predict(0)) == 1
+
+
+# -- vectorizer / split ------------------------------------------------------
+
+def test_binary_vectorizer():
+    rows = [{"color": "red", "size": "L"}, {"color": "blue", "size": "L"}]
+    vec = BinaryVectorizer.fit(rows, ["color", "size"])
+    assert vec.num_features == 3  # red, blue, L
+    v = vec.to_vector({"color": "red", "size": "L"})
+    assert v.sum() == 2.0
+    m = vec.to_matrix(rows)
+    assert m.shape == (2, 3)
+    assert (m.sum(axis=1) == 2).all()
+    # unseen value ignored
+    assert vec.to_vector({"color": "green"}).sum() == 0.0
+
+
+def test_split_data():
+    folds = list(split_data(3, 10))
+    assert len(folds) == 3
+    for train, test in folds:
+        assert len(train) + len(test) == 10
+        assert not set(train) & set(test)
+    all_test = np.concatenate([t for _, t in folds])
+    assert sorted(all_test.tolist()) == list(range(10))

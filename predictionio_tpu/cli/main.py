@@ -565,6 +565,121 @@ def eventserver(ip, port, stats):
     run_event_server(ip=ip, port=port, stats=stats)
 
 
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=7071, type=int)
+def adminserver(ip, port):
+    """Launch the admin API (Console.scala:399, AdminAPI.scala:45)."""
+    from predictionio_tpu.server.admin import run_admin_server
+    click.echo(f"[INFO] Creating Admin API at {ip}:{port}")
+    run_admin_server(ip=ip, port=port)
+
+
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=9000, type=int)
+def dashboard(ip, port):
+    """Launch the evaluation dashboard (Console.scala:371, Dashboard.scala:45)."""
+    from predictionio_tpu.server.dashboard import run_dashboard
+    click.echo(f"[INFO] Creating Dashboard at {ip}:{port}")
+    run_dashboard(ip=ip, port=port)
+
+
+@cli.command()
+def shell():
+    """Interactive REPL with the framework preloaded (bin/pio-shell analog)."""
+    import code
+
+    from predictionio_tpu.data.eventstore import EventStoreClient
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.workflow import WorkflowContext
+
+    banner = ("predictionio_tpu shell\n"
+              "preloaded: Storage, EventStoreClient (PEventStore/LEventStore"
+              " analog), WorkflowContext")
+    local = {"Storage": Storage, "EventStoreClient": EventStoreClient,
+             "WorkflowContext": WorkflowContext}
+    try:
+        import IPython
+
+        IPython.start_ipython(argv=[], user_ns=local)
+    except ImportError:
+        code.interact(banner=banner, local=local)
+
+
+@cli.group()
+def template():
+    """Engine template helpers (Console.scala:595-605)."""
+
+
+@template.command("list")
+def template_list():
+    """List built-in engine templates."""
+    templates = {
+        "recommendation": "predictionio_tpu.engines.recommendation:engine",
+        "similarproduct": "predictionio_tpu.engines.similarproduct:engine",
+        "classification": "predictionio_tpu.engines.classification:engine",
+        "ecommerce": "predictionio_tpu.engines.ecommerce:engine",
+    }
+    for name, factory in templates.items():
+        click.echo(f"[INFO] {name:<16} {factory}")
+
+
+@template.command("get")
+@click.argument("name")
+@click.argument("directory", required=False)
+def template_get(name, directory):
+    """Scaffold an engine.json for a built-in template."""
+    import os
+
+    factories = {
+        "recommendation": ("predictionio_tpu.engines.recommendation:engine",
+                           {"app_name": "MyApp"},
+                           [{"name": "als",
+                             "params": {"rank": 10, "num_iterations": 20,
+                                        "reg": 0.01, "seed": 3}}]),
+        "similarproduct": ("predictionio_tpu.engines.similarproduct:engine",
+                           {"app_name": "MyApp"},
+                           [{"name": "als",
+                             "params": {"rank": 10, "num_iterations": 20}}]),
+        "classification": ("predictionio_tpu.engines.classification:engine",
+                           {"app_name": "MyApp"},
+                           [{"name": "naive", "params": {"reg": 1.0}}]),
+        "ecommerce": ("predictionio_tpu.engines.ecommerce:engine",
+                      {"app_name": "MyApp"},
+                      [{"name": "ecomm",
+                        "params": {"app_name": "MyApp", "rank": 10}}]),
+    }
+    if name not in factories:
+        click.echo(f"[ERROR] Unknown template {name}. "
+                   f"Known: {', '.join(factories)}")
+        sys.exit(1)
+    factory, ds_params, algos = factories[name]
+    target_dir = directory or name
+    os.makedirs(target_dir, exist_ok=True)
+    target = os.path.join(target_dir, "engine.json")
+    with open(target, "w") as f:
+        json.dump({
+            "id": "default",
+            "description": f"{name} engine",
+            "engineFactory": factory,
+            "datasource": {"params": ds_params},
+            "algorithms": algos,
+        }, f, indent=2)
+    click.echo(f"[INFO] Engine template {name} written to {target}")
+
+
+@cli.command()
+@click.argument("main_module")
+@click.argument("args", nargs=-1)
+def run(main_module, args):
+    """Run a module's main() in the framework environment (Console.scala:412)."""
+    import runpy
+
+    sys.argv = [main_module, *args]
+    runpy.run_module(main_module, run_name="__main__")
+
+
 def main():
     cli()
 

@@ -1,0 +1,40 @@
+"""Shared query/result types for the item-recommendation engine family.
+
+The similarproduct and ecommerce templates share the reference's
+{"itemScores": [{"item": ..., "score": ...}]} wire shape and the
+category/white/black candidate rules (isCandidateItem in both templates);
+they are defined once here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Item:
+    categories: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    item_scores: List[ItemScore]
+
+    def to_dict(self):
+        return {"itemScores": [{"item": s.item, "score": s.score}
+                               for s in self.item_scores]}
+
+
+def categories_match(item: Optional[Item], wanted) -> bool:
+    """True when no category filter, or the item shares a category with it."""
+    if not wanted:
+        return True
+    cats = (item or Item()).categories or []
+    return bool(set(wanted) & set(cats))

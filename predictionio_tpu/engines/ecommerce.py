@@ -26,13 +26,11 @@ import numpy as np
 from predictionio_tpu.core import Engine, EngineParams, FirstServing, Params, Preparator
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
+from predictionio_tpu.engines.common import (
+    Item, ItemScore, PredictedResult, categories_match,
+)
 from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
-
-
-@dataclasses.dataclass
-class Item:
-    categories: Optional[List[str]] = None
 
 
 @dataclasses.dataclass
@@ -59,21 +57,6 @@ class Query:
             v = getattr(self, f)
             if v is not None:
                 object.__setattr__(self, f, tuple(v))
-
-
-@dataclasses.dataclass
-class ItemScore:
-    item: str
-    score: float
-
-
-@dataclasses.dataclass
-class PredictedResult:
-    item_scores: List[ItemScore]
-
-    def to_dict(self):
-        return {"itemScores": [{"item": s.item, "score": s.score}
-                               for s in self.item_scores]}
 
 
 @dataclasses.dataclass
@@ -133,6 +116,7 @@ class ECommModel:
     item_vocab: np.ndarray
     U: np.ndarray
     V: np.ndarray
+    V_normalized: np.ndarray     # row-normalized V for similarity scoring
     items: Dict[int, Item]
     popular_count: Dict[int, int]
 
@@ -188,8 +172,10 @@ class ECommAlgorithm(Algorithm):
             idx = vocab_index(item_vocab, i)
             if idx is not None:
                 popular[idx] = popular.get(idx, 0) + 1
+        Vn = V / np.maximum(np.linalg.norm(V, axis=1, keepdims=True), 1e-9)
         return ECommModel(user_vocab=user_vocab, item_vocab=item_vocab,
-                          U=U, V=V, items=item_meta, popular_count=popular)
+                          U=U, V=V, V_normalized=Vn, items=item_meta,
+                          popular_count=popular)
 
     # -- serving-time business rules -----------------------------------------
     def _gen_black_list(self, query: Query) -> Set[str]:
@@ -243,20 +229,21 @@ class ECommAlgorithm(Algorithm):
             if idx is not None:
                 ok[idx] = False
         if query.categories:
-            want = set(query.categories)
             for idx in range(n):
-                cats = (model.items.get(idx) or Item()).categories or []
-                if not want & set(cats):
+                if not categories_match(model.items.get(idx),
+                                        query.categories):
                     ok[idx] = False
         return ok
 
     def _top(self, scores: np.ndarray, ok: np.ndarray, model: ECommModel,
              num: int) -> PredictedResult:
+        """Top-num candidates with score > 0 (predictKnownUser:453 /
+        predictSimilar:518 filter parity)."""
         scores = np.where(ok, scores, -np.inf)
         order = np.argsort(-scores)[:num]
         out = [ItemScore(item=str(model.item_vocab[int(i)]),
                          score=float(scores[int(i)]))
-               for i in order if np.isfinite(scores[int(i)])]
+               for i in order if scores[int(i)] > 0]
         return PredictedResult(item_scores=out)
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
@@ -270,8 +257,7 @@ class ECommAlgorithm(Algorithm):
         recent_idx = [i for i in (model.item_index(x) for x in recent)
                       if i is not None]
         if recent_idx:                               # predictSimilar:497
-            Vn = model.V / np.maximum(
-                np.linalg.norm(model.V, axis=1, keepdims=True), 1e-9)
+            Vn = model.V_normalized
             qsum = Vn[recent_idx].sum(axis=0)
             scores = Vn @ qsum
             for i in recent_idx:

@@ -26,6 +26,9 @@ import numpy as np
 from predictionio_tpu.core import Engine, EngineParams, FirstServing, Params, Preparator
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
+from predictionio_tpu.engines.common import (
+    Item, ItemScore, PredictedResult, categories_match,
+)
 from predictionio_tpu.data.event import millis
 from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
@@ -33,11 +36,6 @@ from predictionio_tpu.models.cooccurrence import CooccurrenceModel, train_cooccu
 
 
 # -- data types ---------------------------------------------------------------
-
-@dataclasses.dataclass
-class Item:
-    categories: Optional[List[str]] = None
-
 
 @dataclasses.dataclass
 class ViewEvent:
@@ -79,21 +77,6 @@ class Query:
             v = getattr(self, f)
             if v is not None:
                 object.__setattr__(self, f, tuple(v))
-
-
-@dataclasses.dataclass
-class ItemScore:
-    item: str
-    score: float
-
-
-@dataclasses.dataclass
-class PredictedResult:
-    item_scores: List[ItemScore]
-
-    def to_dict(self):
-        return {"itemScores": [{"item": s.item, "score": s.score}
-                               for s in self.item_scores]}
 
 
 # -- DASE ---------------------------------------------------------------------
@@ -170,11 +153,7 @@ def _candidate_ok(idx: int, items: Dict[int, Item],
         return False
     if idx in black:
         return False
-    if query.categories:
-        cats = (items.get(idx) or Item()).categories or []
-        if not set(query.categories) & set(cats):
-            return False
-    return True
+    return categories_match(items.get(idx), query.categories)
 
 
 def _score_and_filter(model: SimilarityModel, scores: np.ndarray,
@@ -309,29 +288,16 @@ class CooccurrenceAlgorithm(Algorithm):
 
     def predict(self, m: CooccurrenceEngineModel, query: Query
                 ) -> PredictedResult:
-        query_idx = {i for i in (m.model.item_index(x) for x in query.items)
-                     if i is not None}
-        counts: Dict[int, int] = {}
-        for q in query_idx:
-            for cand, c in m.model.top_cooccurrences.get(q, []):
-                counts[cand] = counts.get(cand, 0) + c
-        white = None
-        if query.white_list is not None:
-            white = {i for i in (m.model.item_index(x)
-                                 for x in query.white_list) if i is not None}
-        black = set()
-        if query.black_list is not None:
-            black = {i for i in (m.model.item_index(x)
-                                 for x in query.black_list) if i is not None}
-        out = []
-        for cand, c in sorted(counts.items(), key=lambda x: -x[1]):
-            if not _candidate_ok(cand, m.items, query_idx, query, white, black):
-                continue
-            out.append(ItemScore(item=str(m.model.item_vocab[cand]),
-                                 score=float(c)))
-            if len(out) >= query.num:
-                break
-        return PredictedResult(item_scores=out)
+        similar = m.model.similar(
+            list(query.items), num=query.num,
+            white_list=(list(query.white_list)
+                        if query.white_list is not None else None),
+            black_list=(list(query.black_list)
+                        if query.black_list is not None else None),
+            candidate_filter=lambda idx: categories_match(
+                m.items.get(idx), query.categories))
+        return PredictedResult(item_scores=[
+            ItemScore(item=i, score=c) for i, c in similar])
 
 
 class SimilarProductServing(FirstServing):

@@ -153,15 +153,23 @@ def _half_sweep(opposite: jax.Array, coo_tgt, coo_seg, coo_val, coo_w,
         # similarproduct LikeAlgorithm convention).
         # A_s = V^T V + sum (c-1) f f^T + lam I ; b_s = sum c p f
         # One segment pass: gram weights (c-1); rhs values c*p/(c-1) so that
-        # value * weight = c * p exactly.
-        cm1 = params.alpha * jnp.abs(coo_val)            # c - 1
-        p = jnp.where(coo_val > 0, 1.0, 0.0)
-        vals = jnp.where(cm1 > 0,
-                         (1.0 + cm1) * p / jnp.maximum(cm1, 1e-12), 0.0)
+        # value * weight = c * p exactly. alpha == 0 degenerates to c = 1
+        # (unweighted implicit), where the gram correction vanishes and the
+        # rhs is a plain preference sum — use a direct pass for that case.
         gram_all = opposite.T @ opposite                 # [K, K] MXU
-        gram, rhs, _ = segment_gram_rhs(
-            opposite, coo_tgt, coo_seg, values=vals, weights=coo_w * cm1,
-            num_segments=seg_per_shard, chunk_size=chunk_size)
+        p = jnp.where(coo_val > 0, 1.0, 0.0)
+        if params.alpha == 0:
+            gram, rhs, _ = segment_gram_rhs(
+                opposite, coo_tgt, coo_seg, values=p, weights=coo_w,
+                num_segments=seg_per_shard, chunk_size=chunk_size)
+            gram = jnp.zeros_like(gram)  # (c-1) = 0; keep only the rhs
+        else:
+            cm1 = params.alpha * jnp.abs(coo_val)        # c - 1
+            vals = jnp.where(cm1 > 0,
+                             (1.0 + cm1) * p / jnp.maximum(cm1, 1e-12), 0.0)
+            gram, rhs, _ = segment_gram_rhs(
+                opposite, coo_tgt, coo_seg, values=vals, weights=coo_w * cm1,
+                num_segments=seg_per_shard, chunk_size=chunk_size)
         cnt = segment_count(coo_seg, coo_w, seg_per_shard)
         A = gram_all[None, :, :] + gram
         lam = params.reg * jnp.where(params.weighted_reg, jnp.maximum(cnt, 1.0), 1.0)

@@ -114,10 +114,12 @@ class CooccurrenceModel:
     def similar(self, item_ids: List[str], num: int,
                 exclude_query: bool = True,
                 white_list: Optional[List[str]] = None,
-                black_list: Optional[List[str]] = None
+                black_list: Optional[List[str]] = None,
+                candidate_filter=None,
                 ) -> List[Tuple[str, float]]:
         """Combine the query items' top lists (predict parity: sum counts
-        per candidate, filter, sort desc)."""
+        per candidate, filter, sort desc). candidate_filter(idx) -> bool
+        applies engine-specific rules (e.g. category matching)."""
         query_idx = {i for i in (self.item_index(x) for x in item_ids)
                      if i is not None}
         white = None
@@ -139,6 +141,8 @@ class CooccurrenceModel:
             if white is not None and cand not in white:
                 continue
             if cand in black:
+                continue
+            if candidate_filter is not None and not candidate_filter(cand):
                 continue
             out.append((str(self.item_vocab[cand]), float(c)))
             if len(out) >= num:

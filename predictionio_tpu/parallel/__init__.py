@@ -1,0 +1,20 @@
+"""Distributed substrate: meshes, collectives, multi-host init, sharded IO.
+
+The rebuild's communication backend (SURVEY.md section 2.9 C1): where the
+reference relies on Spark shuffle (netty RPC) between executors, all
+cross-device communication here is XLA collectives over ICI within a slice
+and DCN across slices, set up with `jax.distributed.initialize` and a
+`jax.sharding.Mesh`. No custom transport exists or is needed.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, make_mesh, mesh_shape_from_conf,
+)
+from predictionio_tpu.parallel.distributed import (
+    initialize_distributed, process_count, process_index,
+)
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "make_mesh", "mesh_shape_from_conf",
+    "initialize_distributed", "process_count", "process_index",
+]

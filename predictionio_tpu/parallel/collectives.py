@@ -1,0 +1,63 @@
+"""Named collective helpers for shard_map kernels.
+
+Thin wrappers over XLA collectives (the C1 inventory of SURVEY.md section
+2.9): psum / all_gather / reduce_scatter / ppermute ride ICI within a slice.
+`ring_pass` implements the neighbor-exchange primitive used by ring
+algorithms (ring all-reduce, ring attention-style pipelines): each device
+forwards a block to the next device on the ring while processing its own.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+def all_gather(x, axis: str, *, tiled: bool = True):
+    return lax.all_gather(x, axis_name=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str):
+    return lax.psum_scatter(x, axis_name=axis, tiled=True)
+
+
+def all_to_all(x, axis: str, split_axis: int = 0, concat_axis: int = 0):
+    return lax.all_to_all(x, axis_name=axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ring_pass(x, axis: str, axis_size: int, reverse: bool = False):
+    """Send x to the next device on the ring, receive from the previous.
+
+    The building block of ring pipelines: combined with a lax.fori_loop a
+    kernel can visit every peer's block in axis_size - 1 hops with only
+    neighbor ICI traffic (no all-gather memory spike).
+    """
+    if reverse:
+        perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+    else:
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def ring_reduce(x, axis: str, axis_size: int, op=jnp.add):
+    """All-reduce via explicit ring passes (didactic/reference path — prefer
+    psum, which XLA lowers to the same ring on TPU)."""
+    acc = x
+    block = x
+
+    def body(_, carry):
+        acc, block = carry
+        block = ring_pass(block, axis, axis_size)
+        return op(acc, block), block
+
+    acc, _ = lax.fori_loop(0, axis_size - 1, body, (acc, block))
+    return acc

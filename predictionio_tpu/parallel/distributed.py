@@ -1,0 +1,85 @@
+"""Multi-host (multi-process) runtime initialization.
+
+The single-controller analog of the reference's driver/executor control plane
+(SURVEY.md section 2.9 P5): every host runs the same program,
+`jax.distributed.initialize` wires them into one JAX runtime, and
+`jax.devices()` then spans all hosts — meshes built afterwards schedule XLA
+collectives over ICI within a slice and DCN across slices. Training scripts
+call initialize_distributed() first (a no-op single-host).
+
+Env contract (standard JAX):
+  PIO_COORDINATOR_ADDRESS  host:port of process 0 (or JAX autodetects on TPU pods)
+  PIO_NUM_PROCESSES        total process count
+  PIO_PROCESS_ID           this process's index
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("pio.distributed")
+
+_initialized = False
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Idempotent jax.distributed.initialize with PIO_* env fallbacks.
+
+    On TPU pods with no explicit configuration, jax autodetects topology;
+    single-host runs skip initialization entirely.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "PIO_COORDINATOR_ADDRESS")
+    num_processes = num_processes if num_processes is not None else (
+        int(os.environ["PIO_NUM_PROCESSES"])
+        if "PIO_NUM_PROCESSES" in os.environ else None)
+    process_id = process_id if process_id is not None else (
+        int(os.environ["PIO_PROCESS_ID"])
+        if "PIO_PROCESS_ID" in os.environ else None)
+
+    if coordinator_address is None and num_processes is None:
+        logger.info("single-process run; jax.distributed not initialized")
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    logger.info("jax.distributed initialized: process %s/%s",
+                jax.process_index(), jax.process_count())
+    _initialized = True
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def global_array_from_local(mesh, local: "object", axis: str = "data"):
+    """Assemble a mesh-sharded global array from each process's local shard.
+
+    The sharded event-log reader contract (SURVEY.md P2): each host loads its
+    slice of the training data, and this stitches them into one global array
+    sharded along `axis` without gathering to any single host.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.make_array_from_process_local_data(sharding, local)

@@ -1,0 +1,57 @@
+"""Device mesh construction.
+
+Canonical axis names for the framework:
+  * "data"  — batch/entity sharding (users, items, events, queries)
+  * "model" — factor/parameter sharding (reserved for large-rank models)
+
+Meshes default to 1D over all devices; engine variants request shapes via
+runtime_conf (the sparkConf analog, workflow/context.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Optional[Sequence[str]] = None,
+              devices=None):
+    """Build a Mesh over the given (or all) devices.
+
+    shape=None -> 1D ("data",) over every device. Multi-host: jax.devices()
+    already spans all processes after initialize_distributed, so the same
+    call shapes a global mesh whose collectives ride ICI intra-slice and DCN
+    across slices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+        axis_names = axis_names or (DATA_AXIS,)
+    else:
+        shape = tuple(shape)
+        axis_names = tuple(axis_names) if axis_names else tuple(
+            f"axis{i}" for i in range(len(shape)))
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, "
+                         f"only {len(devices)} available")
+    return Mesh(np.asarray(devices[:n]).reshape(shape), axis_names=axis_names)
+
+
+def mesh_shape_from_conf(conf: dict) -> Tuple[Optional[list], Optional[list]]:
+    """Parse runtime_conf {"mesh_shape": "4,2", "mesh_axes": "data,model"}."""
+    shape = conf.get("mesh_shape")
+    if isinstance(shape, str):
+        shape = [int(x) for x in shape.split(",") if x]
+    axes = conf.get("mesh_axes")
+    if isinstance(axes, str):
+        axes = [x for x in axes.split(",") if x]
+    return shape, axes

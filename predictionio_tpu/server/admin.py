@@ -1,0 +1,135 @@
+"""Admin REST API — port 7071.
+
+Parity with the reference AdminAPI (tools/.../admin/AdminAPI.scala:45-129)
+and its CommandClient (tools/.../admin/CommandClient.scala):
+
+  GET    /                      -> {"status": "alive"}
+  GET    /cmd/app               -> list apps
+  POST   /cmd/app               -> create app (body {"name": ..., "id"?, "description"?})
+  DELETE /cmd/app/<name>        -> delete app + data
+  DELETE /cmd/app/<name>/data   -> wipe app event data
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from aiohttp import web
+
+from predictionio_tpu.storage.base import AccessKey, App
+from predictionio_tpu.storage.registry import Storage
+
+logger = logging.getLogger("pio.admin")
+
+DEFAULT_PORT = 7071
+
+
+async def _run(fn, *args):
+    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+
+async def handle_root(request):
+    return web.json_response({"status": "alive"})
+
+
+async def handle_app_list(request):
+    def _list():
+        apps = Storage.get_meta_data_apps().get_all()
+        keys = Storage.get_meta_data_access_keys()
+        return [{"name": a.name, "id": a.id,
+                 "accessKeys": [k.key for k in keys.get_by_appid(a.id)]}
+                for a in apps]
+    return web.json_response({"status": 1, "apps": await _run(_list)})
+
+
+async def handle_app_new(request):
+    try:
+        body = await request.json()
+        name = body["name"]
+    except Exception:
+        return web.json_response(
+            {"status": 0, "message": "body must be JSON with a name"},
+            status=400)
+
+    def _create():
+        apps = Storage.get_meta_data_apps()
+        if apps.get_by_name(name):
+            return None
+        app_id = apps.insert(App(id=int(body.get("id") or 0), name=name,
+                                 description=body.get("description")))
+        if app_id is None:
+            return None
+        Storage.get_events().init_channel(app_id)
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey(key="", appid=app_id, events=()))
+        return app_id, key
+
+    out = await _run(_create)
+    if out is None:
+        return web.json_response(
+            {"status": 0, "message": f"App {name} already exists."}, status=409)
+    app_id, key = out
+    return web.json_response(
+        {"status": 1, "id": app_id, "name": name, "accessKey": key},
+        status=201)
+
+
+async def handle_app_delete(request):
+    name = request.match_info["name"]
+
+    def _delete():
+        apps = Storage.get_meta_data_apps()
+        app = apps.get_by_name(name)
+        if app is None:
+            return False
+        events = Storage.get_events()
+        channels = Storage.get_meta_data_channels()
+        for c in channels.get_by_appid(app.id):
+            events.remove_channel(app.id, c.id)
+            channels.delete(c.id)
+        events.remove_channel(app.id)
+        for k in Storage.get_meta_data_access_keys().get_by_appid(app.id):
+            Storage.get_meta_data_access_keys().delete(k.key)
+        apps.delete(app.id)
+        return True
+
+    if await _run(_delete):
+        return web.json_response(
+            {"status": 1, "message": f"App {name} deleted."})
+    return web.json_response(
+        {"status": 0, "message": f"App {name} does not exist."}, status=404)
+
+
+async def handle_app_data_delete(request):
+    name = request.match_info["name"]
+
+    def _wipe():
+        app = Storage.get_meta_data_apps().get_by_name(name)
+        if app is None:
+            return False
+        events = Storage.get_events()
+        events.remove_channel(app.id)
+        events.init_channel(app.id)
+        return True
+
+    if await _run(_wipe):
+        return web.json_response(
+            {"status": 1, "message": f"Data of app {name} deleted."})
+    return web.json_response(
+        {"status": 0, "message": f"App {name} does not exist."}, status=404)
+
+
+def create_admin_server() -> web.Application:
+    app = web.Application()
+    app.router.add_get("/", handle_root)
+    app.router.add_get("/cmd/app", handle_app_list)
+    app.router.add_post("/cmd/app", handle_app_new)
+    app.router.add_delete("/cmd/app/{name}", handle_app_delete)
+    app.router.add_delete("/cmd/app/{name}/data", handle_app_data_delete)
+    return app
+
+
+def run_admin_server(ip: str = "localhost", port: int = DEFAULT_PORT) -> None:
+    logger.info("Admin API listening on %s:%s", ip, port)
+    web.run_app(create_admin_server(), host=ip, port=port, print=None)

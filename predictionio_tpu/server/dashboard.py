@@ -1,0 +1,90 @@
+"""Evaluation dashboard — port 9000.
+
+Parity with the reference Dashboard (tools/.../dashboard/Dashboard.scala:45-162):
+an HTML index of completed EvaluationInstances (newest first) with per-instance
+detail pages rendering the stored evaluator HTML, plus JSON endpoints for
+programmatic access.
+"""
+
+from __future__ import annotations
+
+import html
+import logging
+
+from aiohttp import web
+
+from predictionio_tpu.storage.registry import Storage
+
+logger = logging.getLogger("pio.dashboard")
+
+DEFAULT_PORT = 9000
+
+
+def _index_html(instances) -> str:
+    rows = "".join(
+        f"<tr><td><a href='/engine_instances/{html.escape(i.id)}'>"
+        f"{html.escape(i.id)}</a></td>"
+        f"<td>{html.escape(i.evaluation_class)}</td>"
+        f"<td>{i.start_time.isoformat()}</td>"
+        f"<td>{i.end_time.isoformat()}</td>"
+        f"<td>{html.escape(i.evaluator_results)}</td></tr>"
+        for i in instances)
+    return (
+        "<html><head><title>predictionio_tpu dashboard</title></head><body>"
+        "<h1>Completed evaluations</h1>"
+        "<table border=1><tr><th>ID</th><th>Evaluation</th><th>Started</th>"
+        f"<th>Finished</th><th>Result</th></tr>{rows}</table></body></html>")
+
+
+async def handle_index(request):
+    instances = Storage.get_meta_data_evaluation_instances().get_completed()
+    return web.Response(text=_index_html(instances), content_type="text/html")
+
+
+async def handle_detail(request):
+    instance_id = request.match_info["instance_id"]
+    instance = Storage.get_meta_data_evaluation_instances().get(instance_id)
+    if instance is None:
+        raise web.HTTPNotFound(text="evaluation instance not found")
+    body = instance.evaluator_results_html or (
+        f"<html><body><pre>{html.escape(instance.evaluator_results)}</pre>"
+        "</body></html>")
+    return web.Response(text=body, content_type="text/html")
+
+
+async def handle_index_json(request):
+    instances = Storage.get_meta_data_evaluation_instances().get_completed()
+    return web.json_response([{
+        "id": i.id,
+        "evaluationClass": i.evaluation_class,
+        "startTime": i.start_time.isoformat(),
+        "endTime": i.end_time.isoformat(),
+        "result": i.evaluator_results,
+    } for i in instances])
+
+
+async def handle_detail_json(request):
+    instance_id = request.match_info["instance_id"]
+    instance = Storage.get_meta_data_evaluation_instances().get(instance_id)
+    if instance is None:
+        return web.json_response({"message": "Not Found"}, status=404)
+    return web.json_response({
+        "id": instance.id,
+        "evaluationClass": instance.evaluation_class,
+        "result": instance.evaluator_results,
+        "resultJSON": instance.evaluator_results_json,
+    })
+
+
+def create_dashboard() -> web.Application:
+    app = web.Application()
+    app.router.add_get("/", handle_index)
+    app.router.add_get("/engine_instances/{instance_id}", handle_detail)
+    app.router.add_get("/evaluations.json", handle_index_json)
+    app.router.add_get("/evaluations/{instance_id}.json", handle_detail_json)
+    return app
+
+
+def run_dashboard(ip: str = "localhost", port: int = DEFAULT_PORT) -> None:
+    logger.info("Dashboard listening on %s:%s", ip, port)
+    web.run_app(create_dashboard(), host=ip, port=port, print=None)

@@ -1,0 +1,8 @@
+"""Shared utilities: env config, logging setup, profiling hooks."""
+
+from predictionio_tpu.utils.config import pio_env_vars, pio_home
+from predictionio_tpu.utils.logging_util import configure_logging
+from predictionio_tpu.utils.profiling import trace_annotation, profile_trace
+
+__all__ = ["pio_env_vars", "pio_home", "configure_logging",
+           "trace_annotation", "profile_trace"]

@@ -1,0 +1,16 @@
+"""Logging configuration (WorkflowUtils.modifyLogging:271 analog)."""
+
+from __future__ import annotations
+
+import logging
+
+
+def configure_logging(verbose: bool = False) -> None:
+    level = logging.DEBUG if verbose else logging.INFO
+    logging.basicConfig(
+        level=level,
+        format="[%(levelname)s] [%(name)s] %(message)s")
+    # quiet the noisy substrate loggers unless verbose
+    if not verbose:
+        for name in ("jax", "aiohttp.access"):
+            logging.getLogger(name).setLevel(logging.WARNING)

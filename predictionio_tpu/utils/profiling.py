@@ -1,0 +1,34 @@
+"""Profiling hooks.
+
+The reference has no tracing beyond Spark's UI (SURVEY.md section 5); the
+rebuild adds jax.profiler integration: wrap train steps in profile_trace to
+capture a TensorBoard-compatible device trace, and trace_annotation to name
+regions inside it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+logger = logging.getLogger("pio.profiling")
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a jax.profiler trace around a block (train step, sweep)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", log_dir)
+
+
+def trace_annotation(name: str):
+    """Named region inside a device trace (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -34,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from predictionio_tpu.core.params import Params
 from predictionio_tpu.data.bimap import vocab_index
 from predictionio_tpu.ops.linalg import batched_spd_solve
-from predictionio_tpu.ops.segment import segment_count, segment_gram_rhs
+from predictionio_tpu.ops.segment import rows_gram_rhs, segment_count
 
 
 @dataclasses.dataclass
@@ -49,76 +49,124 @@ class ALSParams(Params):
     implicit_prefs: bool = False
     weighted_reg: bool = True   # ALS-WR: lambda scaled by per-entity count
     seed: int = 3
-    chunk_size: int = 16384
+    #: rows per lax.scan chunk — bounds the gather/matmul buffer (the padded
+    #: row length itself is a data-layout knob on ALSData.build)
+    chunk_size: int = 8192
 
 
 # ---------------------------------------------------------------------------
-# Host-side data layout
+# Host-side data layout (ALX-style padded rows)
 # ---------------------------------------------------------------------------
 
 @dataclasses.dataclass
-class ShardedCOO:
-    """Ratings sorted by segment and split into per-shard blocks.
+class ShardedRows:
+    """Ratings packed into padded per-segment rows, split across shards.
 
-    Arrays are [n_shards, max_shard_nnz]; shard s owns contiguous segments
-    [s * seg_per_shard, (s+1) * seg_per_shard). seg is the LOCAL segment
-    index within the shard; weight 0 marks padding.
+    Row r holds up to L ratings of ONE segment (heavy segments span several
+    consecutive rows); shard s owns contiguous segments
+    [s * seg_per_shard, (s+1) * seg_per_shard). This layout turns Gramian
+    assembly into batched [L, K] matmuls on the MXU with one small combine
+    scatter per row — the ALX layout (PAPERS.md) — instead of per-rating
+    scatter-adds.
     """
 
-    tgt: np.ndarray   # int32 — opposite-side factor row of each rating
-    seg: np.ndarray   # int32 — local segment index
-    val: np.ndarray   # float32 — rating value
-    w: np.ndarray     # float32 — confidence/validity weight
+    tgt: np.ndarray   # int32 [D, R, L] — opposite-side factor rows
+    val: np.ndarray   # float32 [D, R, L] — rating values
+    w: np.ndarray     # float32 [D, R, L] — weights (0 = padding)
+    seg: np.ndarray   # int32 [D, R] — LOCAL segment id of each row (sorted)
     seg_per_shard: int
     n_segments: int   # padded total (n_shards * seg_per_shard)
+    row_len: int
 
 
-def shard_coo(seg_idx: np.ndarray, tgt_idx: np.ndarray, values: np.ndarray,
-              n_segments: int, n_shards: int,
-              weights: Optional[np.ndarray] = None) -> ShardedCOO:
-    """Sort by segment, split at shard boundaries, pad shards to equal nnz."""
+def _auto_row_len(nnz: int, n_segments: int) -> int:
+    mean = max(1.0, nnz / max(n_segments, 1))
+    return int(min(512, max(16, 1 << int(np.ceil(np.log2(mean))))))
+
+
+def _build_rows(seg_local: np.ndarray, tgt: np.ndarray, val: np.ndarray,
+                weights: Optional[np.ndarray], row_len: int,
+                seg_per_shard: int):
+    """Pack one shard's (sorted-by-segment) ratings into padded rows."""
+    n = len(seg_local)
+    if n == 0:
+        return (np.zeros((1, row_len), np.int32),
+                np.zeros((1, row_len), np.float32),
+                np.zeros((1, row_len), np.float32),
+                np.full((1,), seg_per_shard - 1, np.int32))
+    uniq, first_idx, counts = np.unique(
+        seg_local, return_index=True, return_counts=True)
+    rows_per = -(-counts // row_len)
+    row_start = np.concatenate([[0], np.cumsum(rows_per)])
+    inv = np.searchsorted(uniq, seg_local)
+    pos = np.arange(n) - first_idx[inv]
+    rrow = row_start[inv] + pos // row_len
+    col = pos % row_len
+    n_rows = int(row_start[-1])
+    tgt_out = np.zeros((n_rows, row_len), np.int32)
+    val_out = np.zeros((n_rows, row_len), np.float32)
+    w_out = np.zeros((n_rows, row_len), np.float32)
+    tgt_out[rrow, col] = tgt
+    val_out[rrow, col] = val
+    w_out[rrow, col] = weights if weights is not None else 1.0
+    row_seg = np.repeat(uniq, rows_per).astype(np.int32)
+    return tgt_out, val_out, w_out, row_seg
+
+
+def shard_rows(seg_idx: np.ndarray, tgt_idx: np.ndarray, values: np.ndarray,
+               n_segments: int, n_shards: int,
+               weights: Optional[np.ndarray] = None,
+               row_len: Optional[int] = None) -> ShardedRows:
+    """Sort by segment, split at shard boundaries, pack into padded rows."""
     order = np.argsort(seg_idx, kind="stable")
-    seg_s = seg_idx[order].astype(np.int32)
+    seg_s = seg_idx[order].astype(np.int64)
     tgt_s = tgt_idx[order].astype(np.int32)
     val_s = values[order].astype(np.float32)
-    w_s = (weights[order].astype(np.float32) if weights is not None
-           else np.ones_like(val_s))
+    w_s = weights[order].astype(np.float32) if weights is not None else None
+    nnz = len(seg_s)
+    if row_len is None:
+        row_len = _auto_row_len(nnz, n_segments)
 
     seg_per_shard = -(-max(n_segments, 1) // n_shards)
     bounds = np.searchsorted(
         seg_s, np.arange(1, n_shards) * seg_per_shard, side="left")
-    splits = np.split(np.arange(seg_s.shape[0]), bounds)
-    max_nnz = max((len(s) for s in splits), default=1) or 1
+    starts = np.concatenate([[0], bounds, [nnz]]).astype(np.int64)
 
-    def shard_arrays(src, fill, local_seg=False):
-        out = np.full((n_shards, max_nnz), fill, dtype=src.dtype)
-        for s, idx in enumerate(splits):
-            row = src[idx]
-            if local_seg:
-                row = row - s * seg_per_shard
-            out[s, :len(idx)] = row
+    per_shard = []
+    for s in range(n_shards):
+        lo, hi = int(starts[s]), int(starts[s + 1])
+        per_shard.append(_build_rows(
+            seg_s[lo:hi] - s * seg_per_shard, tgt_s[lo:hi], val_s[lo:hi],
+            w_s[lo:hi] if w_s is not None else None, row_len, seg_per_shard))
+    r_max = max(t.shape[0] for t, _, _, _ in per_shard)
+
+    def _stack(idx, fill, dtype, shape_tail):
+        out = np.full((n_shards, r_max) + shape_tail, fill, dtype=dtype)
+        for s, parts in enumerate(per_shard):
+            a = parts[idx]
+            out[s, :a.shape[0]] = a
         return out
 
-    w_out = np.zeros((n_shards, max_nnz), dtype=np.float32)
-    for s, idx in enumerate(splits):
-        w_out[s, :len(idx)] = w_s[idx]
-
-    return ShardedCOO(
-        tgt=shard_arrays(tgt_s, 0),
-        seg=shard_arrays(seg_s, 0, local_seg=True),
-        val=shard_arrays(val_s, 0.0),
-        w=w_out,
+    seg_out = np.full((n_shards, r_max), seg_per_shard - 1, np.int32)
+    for s, (_, _, _, rs) in enumerate(per_shard):
+        seg_out[s, :rs.shape[0]] = rs
+    return ShardedRows(
+        tgt=_stack(0, 0, np.int32, (row_len,)),
+        val=_stack(1, 0.0, np.float32, (row_len,)),
+        w=_stack(2, 0.0, np.float32, (row_len,)),
+        seg=seg_out,
         seg_per_shard=seg_per_shard,
         n_segments=n_shards * seg_per_shard,
+        row_len=row_len,
     )
 
 
 @dataclasses.dataclass
 class ALSData:
-    """Device-ready training layout: the COO sorted both ways + dims."""
+    """Device-ready training layout: padded rows sorted both ways + dims."""
 
-    by_user: ShardedCOO    # seg=user, tgt=item
-    by_item: ShardedCOO    # seg=item, tgt=user
+    by_user: ShardedRows    # seg=user, tgt=item
+    by_item: ShardedRows    # seg=item, tgt=user
     n_users: int
     n_items: int
     n_users_pad: int
@@ -128,9 +176,11 @@ class ALSData:
     @classmethod
     def build(cls, user_idx: np.ndarray, item_idx: np.ndarray,
               ratings: np.ndarray, n_users: int, n_items: int,
-              n_shards: int) -> "ALSData":
-        by_user = shard_coo(user_idx, item_idx, ratings, n_users, n_shards)
-        by_item = shard_coo(item_idx, user_idx, ratings, n_items, n_shards)
+              n_shards: int, row_len: Optional[int] = None) -> "ALSData":
+        by_user = shard_rows(user_idx, item_idx, ratings, n_users, n_shards,
+                             row_len=row_len)
+        by_item = shard_rows(item_idx, user_idx, ratings, n_items, n_shards,
+                             row_len=row_len)
         return cls(by_user=by_user, by_item=by_item,
                    n_users=n_users, n_items=n_items,
                    n_users_pad=by_user.n_segments,
@@ -142,42 +192,43 @@ class ALSData:
 # Device sweeps
 # ---------------------------------------------------------------------------
 
-def _half_sweep(opposite: jax.Array, coo_tgt, coo_seg, coo_val, coo_w,
+def _half_sweep(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
                 seg_per_shard: int, params: ALSParams,
-                chunk_size: int) -> jax.Array:
+                chunk_rows: int) -> jax.Array:
     """Solve this side's factors for one shard. opposite is the full
-    (replicated) opposite-side factor matrix."""
+    (replicated) opposite-side factor matrix; rows are the padded ALX layout."""
     if params.implicit_prefs:
         # Hu-Koren-Volinsky: preference p = [r > 0], confidence
         # c = 1 + alpha * |r| (negative r = confident dislike, the
         # similarproduct LikeAlgorithm convention).
         # A_s = V^T V + sum (c-1) f f^T + lam I ; b_s = sum c p f
-        # One segment pass: gram weights (c-1); rhs values c*p/(c-1) so that
+        # One row pass: gram weights (c-1); rhs values c*p/(c-1) so that
         # value * weight = c * p exactly. alpha == 0 degenerates to c = 1
         # (unweighted implicit), where the gram correction vanishes and the
         # rhs is a plain preference sum — use a direct pass for that case.
         gram_all = opposite.T @ opposite                 # [K, K] MXU
-        p = jnp.where(coo_val > 0, 1.0, 0.0)
+        p = jnp.where(row_val > 0, 1.0, 0.0)
         if params.alpha == 0:
-            gram, rhs, _ = segment_gram_rhs(
-                opposite, coo_tgt, coo_seg, values=p, weights=coo_w,
-                num_segments=seg_per_shard, chunk_size=chunk_size)
+            gram, rhs, cnt = rows_gram_rhs(
+                opposite, row_tgt, row_seg, row_val=p, row_w=row_w,
+                num_segments=seg_per_shard, chunk_rows=chunk_rows)
             gram = jnp.zeros_like(gram)  # (c-1) = 0; keep only the rhs
         else:
-            cm1 = params.alpha * jnp.abs(coo_val)        # c - 1
+            cm1 = params.alpha * jnp.abs(row_val)        # c - 1
             vals = jnp.where(cm1 > 0,
                              (1.0 + cm1) * p / jnp.maximum(cm1, 1e-12), 0.0)
-            gram, rhs, _ = segment_gram_rhs(
-                opposite, coo_tgt, coo_seg, values=vals, weights=coo_w * cm1,
-                num_segments=seg_per_shard, chunk_size=chunk_size)
-        cnt = segment_count(coo_seg, coo_w, seg_per_shard)
+            gram, rhs, _ = rows_gram_rhs(
+                opposite, row_tgt, row_seg,
+                row_val=vals, row_w=row_w * cm1,
+                num_segments=seg_per_shard, chunk_rows=chunk_rows)
+            cnt = segment_count(row_seg, row_w.sum(axis=1), seg_per_shard)
         A = gram_all[None, :, :] + gram
         lam = params.reg * jnp.where(params.weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
         A = A + lam[:, None, None] * jnp.eye(opposite.shape[1], dtype=A.dtype)
         return batched_spd_solve(A, rhs)
-    gram, rhs, cnt = segment_gram_rhs(
-        opposite, coo_tgt, coo_seg, values=coo_val, weights=coo_w,
-        num_segments=seg_per_shard, chunk_size=chunk_size)
+    gram, rhs, cnt = rows_gram_rhs(
+        opposite, row_tgt, row_seg, row_val=row_val, row_w=row_w,
+        num_segments=seg_per_shard, chunk_rows=chunk_rows)
     lam = params.reg * jnp.where(params.weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
     A = gram + lam[:, None, None] * jnp.eye(opposite.shape[1], dtype=gram.dtype)
     return batched_spd_solve(A, rhs)
@@ -199,23 +250,24 @@ def make_train_fn(mesh: Mesh, data_dims, params: ALSParams):
     chunk = params.chunk_size
 
     def user_block(V, tgt, seg, val, w):
-        # one shard: [1, nnz] blocks -> local users [ups, K]
+        # one shard: [1, R, L] row blocks -> local users [ups, K]
         return _half_sweep(V, tgt[0], seg[0], val[0], w[0], ups, params, chunk)[None]
 
     def item_block(U, tgt, seg, val, w):
         return _half_sweep(U, tgt[0], seg[0], val[0], w[0], ips, params, chunk)[None]
 
-    # check_vma=False: the generic segment kernel mixes replicated factor
-    # inputs with device-varying COO chunks inside lax.scan; correctness is
+    # check_vma=False: the generic row kernel mixes replicated factor
+    # inputs with device-varying row chunks inside lax.scan; correctness is
     # covered by the single-vs-8-device equivalence test
-    coo_spec = P(axis, None)
+    row_spec = P(axis, None, None)
+    seg_spec = P(axis, None)
     user_sweep = shard_map(
         user_block, mesh=mesh,
-        in_specs=(P(), coo_spec, coo_spec, coo_spec, coo_spec),
+        in_specs=(P(), row_spec, seg_spec, row_spec, row_spec),
         out_specs=P(axis, None, None), check_vma=False)
     item_sweep = shard_map(
         item_block, mesh=mesh,
-        in_specs=(P(), coo_spec, coo_spec, coo_spec, coo_spec),
+        in_specs=(P(), row_spec, seg_spec, row_spec, row_spec),
         out_specs=P(axis, None, None), check_vma=False)
 
     def train(by_user, by_item, key):
@@ -237,13 +289,41 @@ def make_train_fn(mesh: Mesh, data_dims, params: ALSParams):
     return jax.jit(train)
 
 
+#: memoized jitted train fns — rebuilding the closures on every call would
+#: force a re-trace per training run (FastEvalEngine's compilation-cache
+#: analog; the cache key is everything that shapes the compiled program).
+#: Bounded LRU so long-running servers that retrain on growing data don't
+#: accumulate compiled executables forever.
+_TRAIN_FN_CACHE: "OrderedDict" = None
+_TRAIN_FN_CACHE_MAX = 8
+
+
+def _cached_train_fn(mesh: Mesh, data_dims, params: ALSParams):
+    global _TRAIN_FN_CACHE
+    from collections import OrderedDict
+
+    if _TRAIN_FN_CACHE is None:
+        _TRAIN_FN_CACHE = OrderedDict()
+    key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
+           mesh.axis_names, data_dims, dataclasses.astuple(params))
+    fn = _TRAIN_FN_CACHE.get(key)
+    if fn is None:
+        fn = make_train_fn(mesh, data_dims, params)
+        _TRAIN_FN_CACHE[key] = fn
+        while len(_TRAIN_FN_CACHE) > _TRAIN_FN_CACHE_MAX:
+            _TRAIN_FN_CACHE.popitem(last=False)
+    else:
+        _TRAIN_FN_CACHE.move_to_end(key)
+    return fn
+
+
 def train_als(mesh: Mesh, data: ALSData, params: ALSParams
               ) -> Tuple[np.ndarray, np.ndarray]:
     """Train and return host (U [n_users, K], V [n_items, K])."""
     n_shards = int(np.prod(mesh.devices.shape))
     assert data.by_user.tgt.shape[0] == n_shards, \
         f"data built for {data.by_user.tgt.shape[0]} shards, mesh has {n_shards}"
-    train = make_train_fn(
+    train = _cached_train_fn(
         mesh, (data.n_users_pad, data.n_items_pad,
                data.by_user.seg_per_shard, data.by_item.seg_per_shard), params)
     key = jax.random.PRNGKey(params.seed)

@@ -6,14 +6,12 @@ examples/.../ALSAlgorithm.scala:85): for every segment (user or item) we
 accumulate the Gramian sum_j w_j f_j f_j^T and right-hand side
 sum_j v_j f_j over that segment's ratings.
 
-Design for the hardware (SURVEY.md section 2.9 P3/P4):
-  * ratings arrive pre-sorted by segment id -> scatter-adds are
-    indices_are_sorted and XLA lowers them to efficient sorted-segment sums
-  * nnz is processed in fixed-size chunks under lax.scan so the temporary
-    outer-product buffer (chunk x K x K) stays bounded regardless of dataset
-    size (20M ratings never materialize a [nnz, K, K] tensor)
-  * all shapes are static: nnz is padded to a chunk multiple with weight-0
-    rows pointing at a scratch segment
+Design for the hardware (SURVEY.md section 2.9 P3/P4): ratings are packed
+into padded per-segment rows (the ALX layout, built host-side in
+models/als.py) so each chunk's Gramians are ONE batched MXU matmul; rows are
+processed in fixed-size chunks under lax.scan so buffers stay bounded at any
+dataset size, and per-segment combines scatter row-granularity partials with
+sorted indices.
 """
 
 from __future__ import annotations
@@ -26,58 +24,63 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def pad_to_multiple(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
-    n = arr.shape[0]
-    target = ((n + multiple - 1) // multiple) * multiple if n else multiple
-    if target == n:
-        return arr
-    pad = np.full((target - n,) + arr.shape[1:], fill, dtype=arr.dtype)
-    return np.concatenate([arr, pad], axis=0)
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_count(seg_idx: jax.Array, weights: jax.Array,
+                  num_segments: int) -> jax.Array:
+    return jnp.zeros((num_segments,), weights.dtype).at[seg_idx].add(weights)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_segments", "chunk_size"))
-def segment_gram_rhs(
-    factors: jax.Array,       # [F, K] factor matrix indexed by tgt_idx
-    tgt_idx: jax.Array,       # [N] which factor row each rating touches
-    seg_idx: jax.Array,       # [N] which segment each rating belongs to (sorted)
-    values: jax.Array,        # [N] rating values (rhs weights)
-    weights: jax.Array,       # [N] confidence/validity weights (0 = padding)
+    jax.jit, static_argnames=("num_segments", "chunk_rows"))
+def rows_gram_rhs(
+    factors: jax.Array,     # [F, K] factor matrix indexed by row_tgt
+    row_tgt: jax.Array,     # [R, L] factor row per rating (padded)
+    row_seg: jax.Array,     # [R] segment of each row (sorted)
+    row_val: jax.Array,     # [R, L] rating values
+    row_w: jax.Array,       # [R, L] weights (0 = padding)
     num_segments: int,
-    chunk_size: int = 16384,
+    chunk_rows: int = 8192,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (gram [S, K, K], rhs [S, K], count [S]).
+    """Padded-row Gramian assembly — the MXU path (ALX layout, PAPERS.md).
 
-    gram[s]  = sum_{j in s} w_j f_j f_j^T
-    rhs[s]   = sum_{j in s} w_j v_j f_j
-    count[s] = sum_{j in s} w_j
+    Each row holds up to L of one segment's ratings; heavy segments span
+    multiple rows. Per chunk the Gramian of every row is ONE batched matmul
+    einsum('clk,cln->ckn') on the MXU, and the per-segment combine scatters
+    only ~nnz/L + S rows instead of nnz — two orders of magnitude less
+    scatter traffic than rating-granularity segment sums at L=128+.
+    Returns (gram [S, K, K], rhs [S, K], count [S]).
     """
     k = factors.shape[-1]
-    n = tgt_idx.shape[0]
-    num_chunks = max(1, (n + chunk_size - 1) // chunk_size)
-    padded = num_chunks * chunk_size
-    if padded != n:
-        # weight-0 padding rows scatter into segment 0 harmlessly
-        pad = padded - n
-        tgt_idx = jnp.concatenate([tgt_idx, jnp.zeros(pad, tgt_idx.dtype)])
-        seg_idx = jnp.concatenate([seg_idx, jnp.zeros(pad, seg_idx.dtype)])
-        values = jnp.concatenate([values, jnp.zeros(pad, values.dtype)])
-        weights = jnp.concatenate([weights, jnp.zeros(pad, weights.dtype)])
+    r, l = row_tgt.shape
+    chunk_rows = min(chunk_rows, max(r, 1))  # never pad past the real rows
+    num_chunks = max(1, (r + chunk_rows - 1) // chunk_rows)
+    padded = num_chunks * chunk_rows
+    if padded != r:
+        pad = padded - r
+        # weight-0 rows aimed at the LAST segment keep row_seg sorted
+        row_tgt = jnp.concatenate(
+            [row_tgt, jnp.zeros((pad, l), row_tgt.dtype)])
+        row_seg = jnp.concatenate(
+            [row_seg, jnp.full((pad,), num_segments - 1, row_seg.dtype)])
+        row_val = jnp.concatenate(
+            [row_val, jnp.zeros((pad, l), row_val.dtype)])
+        row_w = jnp.concatenate([row_w, jnp.zeros((pad, l), row_w.dtype)])
 
-    tgt_c = tgt_idx.reshape(num_chunks, chunk_size)
-    seg_c = seg_idx.reshape(num_chunks, chunk_size)
-    val_c = values.reshape(num_chunks, chunk_size)
-    w_c = weights.reshape(num_chunks, chunk_size)
+    tgt_c = row_tgt.reshape(num_chunks, chunk_rows, l)
+    seg_c = row_seg.reshape(num_chunks, chunk_rows)
+    val_c = row_val.reshape(num_chunks, chunk_rows, l)
+    w_c = row_w.reshape(num_chunks, chunk_rows, l)
 
     def body(carry, chunk):
         gram, rhs, count = carry
         tgt, seg, val, w = chunk
-        f = factors[tgt]                                   # [C, K] gather
-        fw = f * w[:, None]
-        outer = jnp.einsum("ck,cl->ckl", fw, f)            # [C, K, K]
-        gram = gram.at[seg].add(outer, indices_are_sorted=False)
-        rhs = rhs.at[seg].add(f * (val * w)[:, None])
-        count = count.at[seg].add(w)
+        f = factors[tgt]                                  # [C, L, K]
+        fw = f * w[..., None]
+        gram_rows = jnp.einsum("clk,cln->ckn", fw, f)     # batched MXU matmul
+        rhs_rows = jnp.einsum("clk,cl->ck", fw, val)
+        gram = gram.at[seg].add(gram_rows, indices_are_sorted=True)
+        rhs = rhs.at[seg].add(rhs_rows, indices_are_sorted=True)
+        count = count.at[seg].add(w.sum(axis=1), indices_are_sorted=True)
         return (gram, rhs, count), None
 
     init = (jnp.zeros((num_segments, k, k), factors.dtype),
@@ -86,9 +89,3 @@ def segment_gram_rhs(
     (gram, rhs, count), _ = jax.lax.scan(
         body, init, (tgt_c, seg_c, val_c, w_c))
     return gram, rhs, count
-
-
-@functools.partial(jax.jit, static_argnames=("num_segments",))
-def segment_count(seg_idx: jax.Array, weights: jax.Array,
-                  num_segments: int) -> jax.Array:
-    return jnp.zeros((num_segments,), weights.dtype).at[seg_idx].add(weights)

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from predictionio_tpu.models.als import (
-    ALSData, ALSModel, ALSParams, rmse, shard_coo, train_als,
+    ALSData, ALSModel, ALSParams, rmse, shard_rows, train_als,
 )
 
 
@@ -31,28 +31,46 @@ def single_mesh():
     return Mesh(np.asarray(jax.devices()[:1]), axis_names=("data",))
 
 
-def test_shard_coo_layout():
+def test_shard_rows_layout():
     seg = np.array([0, 3, 1, 3, 2, 7])
     tgt = np.array([10, 11, 12, 13, 14, 15])
     val = np.arange(6, dtype=np.float32)
-    coo = shard_coo(seg, tgt, val, n_segments=8, n_shards=4)
-    assert coo.seg_per_shard == 2
-    assert coo.tgt.shape[0] == 4
+    rows = shard_rows(seg, tgt, val, n_segments=8, n_shards=4, row_len=16)
+    assert rows.seg_per_shard == 2
+    assert rows.tgt.shape[0] == 4
+    assert rows.tgt.shape[2] == 16
     # shard 0 owns segments 0-1 (2 ratings), shard 1 owns 2-3 (3 ratings)
-    assert coo.w[0].sum() == 2
-    assert coo.w[1].sum() == 3
-    assert coo.w[2].sum() == 0
-    assert coo.w[3].sum() == 1  # segment 7 -> local 1 on shard 3
-    assert coo.seg[3][0] == 1
-    # local segment ids within range
-    assert (coo.seg < coo.seg_per_shard).all()
+    assert rows.w[0].sum() == 2
+    assert rows.w[1].sum() == 3
+    assert rows.w[2].sum() == 0
+    assert rows.w[3].sum() == 1  # segment 7 -> local 1 on shard 3
+    # local segment ids within range and sorted per shard
+    assert (rows.seg < rows.seg_per_shard).all()
+    for s in range(4):
+        assert (np.diff(rows.seg[s]) >= 0).all()
+    # values land in the right rows: shard 1 has seg 2 (1 rating: val 4)
+    # then seg 3 (2 ratings: vals 1, 3)
+    s1_rows = rows.seg[1]
+    seg2_row = int(np.argmax(s1_rows == 0))
+    assert rows.val[1][seg2_row].sum() == 4.0
+
+
+def test_shard_rows_heavy_segment_spans_rows():
+    # one segment with 10 ratings at row_len=4 -> 3 rows, same seg id
+    seg = np.zeros(10, np.int64)
+    tgt = np.arange(10)
+    val = np.ones(10, np.float32)
+    rows = shard_rows(seg, tgt, val, n_segments=1, n_shards=1, row_len=4)
+    assert rows.tgt.shape[1] == 3
+    assert (rows.seg[0] == 0).all()
+    assert rows.w[0].sum() == 10
 
 
 def test_als_reconstructs_low_rank():
     users, items, ratings, nu, ni = synthetic_ratings()
     data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
     params = ALSParams(rank=8, num_iterations=10, reg=0.01, seed=1,
-                       chunk_size=256)
+                       chunk_size=64)
     U, V = train_als(single_mesh(), data, params)
     assert U.shape == (nu, 8) and V.shape == (ni, 8)
     err = rmse(U, V, users, items, ratings)
@@ -62,7 +80,7 @@ def test_als_reconstructs_low_rank():
 def test_als_sharded_matches_single(mesh8):
     users, items, ratings, nu, ni = synthetic_ratings(seed=2)
     params = ALSParams(rank=6, num_iterations=5, reg=0.05, seed=4,
-                       chunk_size=128)
+                       chunk_size=64)
     d1 = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
     U1, V1 = train_als(single_mesh(), d1, params)
     d8 = ALSData.build(users, items, ratings, nu, ni, n_shards=8)
@@ -91,7 +109,7 @@ def test_als_implicit_ranks_positives_first():
     counts = np.array(counts, np.float32)
     data = ALSData.build(users, items, counts, nu, ni, n_shards=1)
     params = ALSParams(rank=8, num_iterations=10, reg=0.1, alpha=10.0,
-                       implicit_prefs=True, seed=0, chunk_size=128)
+                       implicit_prefs=True, seed=0, chunk_size=64)
     U, V = train_als(single_mesh(), data, params)
     scores = U @ V.T
     # user 0 (group 0) should prefer even items
@@ -104,7 +122,7 @@ def test_als_model_scoring():
     users, items, ratings, nu, ni = synthetic_ratings(seed=3)
     data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
     U, V = train_als(single_mesh(), data,
-                     ALSParams(rank=8, num_iterations=8, chunk_size=256))
+                     ALSParams(rank=8, num_iterations=8, chunk_size=64))
     user_vocab = np.array([f"u{i:03d}" for i in range(nu)], dtype=object)
     item_vocab = np.array([f"i{i:03d}" for i in range(ni)], dtype=object)
     model = ALSModel(user_vocab=user_vocab, item_vocab=item_vocab, U=U, V=V)

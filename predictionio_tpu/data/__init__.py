@@ -9,8 +9,10 @@ from predictionio_tpu.data.datamap import DataMap, DataMapError, PropertyMap
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event
 from predictionio_tpu.data.aggregator import aggregate_properties, aggregate_properties_single
 from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.entity_map import EntityMap
 
 __all__ = [
+    "EntityMap",
     "DataMap",
     "DataMapError",
     "PropertyMap",

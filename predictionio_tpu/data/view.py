@@ -1,0 +1,142 @@
+"""Materialized event views: cached columnar snapshots of the event log.
+
+Parity with the reference's view layer:
+  * DataView.create (data/.../view/DataView.scala:36-108) — a DataFrame
+    materialized to parquet, cache-keyed by a hash of the time range + a
+    caller-supplied schema version so stale caches self-invalidate.
+  * LBatchView / PBatchView (data/.../view/{L,P}BatchView.scala) — batch
+    views exposing aggregateProperties and event-window slices.
+
+The rebuild materializes one pyarrow Table per (app, channel, time-range,
+version) to a parquet file under a cache dir. Training DataSources read the
+view instead of re-querying the store; the table feeds the columnar →
+device-array path (SURVEY.md §2.9 P2).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import logging
+import os
+import tempfile
+from typing import Dict, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from predictionio_tpu.data.aggregator import (
+    AGGREGATOR_EVENT_NAMES, aggregate_properties)
+from predictionio_tpu.data.columnar import events_to_table, table_to_events
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import millis
+from predictionio_tpu.data.eventstore import EventStoreClient
+
+logger = logging.getLogger("pio.view")
+
+def default_cache_dir() -> str:
+    return os.environ.get(
+        "PIO_VIEW_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".pio_tpu", "views"))
+
+
+def _cache_key(app_name: str, channel_name: Optional[str],
+               start_time: Optional[_dt.datetime],
+               until_time: Optional[_dt.datetime], version: str) -> str:
+    """Deterministic cache id (DataView.scala:56 uses MurmurHash of the
+    time-range + schema UID; any stable digest serves the same purpose)."""
+    parts = [
+        app_name, channel_name or "",
+        str(millis(start_time)) if start_time else "-inf",
+        str(millis(until_time)) if until_time else "+inf",
+        version,
+    ]
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+class DataView:
+    """A cached columnar snapshot of one app/channel's events."""
+
+    def __init__(self, app_name: str, channel_name: Optional[str] = None,
+                 start_time: Optional[_dt.datetime] = None,
+                 until_time: Optional[_dt.datetime] = None,
+                 version: str = "0",
+                 cache_dir: Optional[str] = None):
+        self.app_name = app_name
+        self.channel_name = channel_name
+        self.start_time = start_time
+        self.until_time = until_time
+        self.version = version
+        self.cache_dir = cache_dir or default_cache_dir()
+        self._table: Optional[pa.Table] = None
+
+    @property
+    def cache_path(self) -> str:
+        key = _cache_key(self.app_name, self.channel_name,
+                         self.start_time, self.until_time, self.version)
+        return os.path.join(self.cache_dir, f"view_{key}.parquet")
+
+    def create(self, refresh: bool = False) -> pa.Table:
+        """Materialize (or load the cached) snapshot (DataView.create:56)."""
+        if self._table is not None and not refresh:
+            return self._table
+        path = self.cache_path
+        if not refresh and os.path.exists(path):
+            logger.info("view cache hit: %s", path)
+            self._table = pq.read_table(path)
+            return self._table
+        events = EventStoreClient.find(
+            self.app_name, self.channel_name,
+            start_time=self.start_time, until_time=self.until_time)
+        table = events_to_table(events)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        # write-then-rename: a crash or concurrent writer never leaves a
+        # truncated parquet at the cache path
+        fd, tmp = tempfile.mkstemp(dir=self.cache_dir, suffix=".parquet.tmp")
+        os.close(fd)
+        try:
+            pq.write_table(table, tmp)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        logger.info("view materialized: %s (%d rows)", path, table.num_rows)
+        self._table = table
+        return table
+
+    def invalidate(self) -> None:
+        self._table = None
+        try:
+            os.remove(self.cache_path)
+        except FileNotFoundError:
+            pass
+
+
+class BatchView(DataView):
+    """Batch view with the L/PBatchView-style derived accessors."""
+
+    def events(self):
+        return table_to_events(self.create())
+
+    def filtered_table(self, event_names: Optional[Sequence[str]] = None,
+                       entity_type: Optional[str] = None) -> pa.Table:
+        table = self.create()
+        mask = None
+        import pyarrow.compute as pc
+
+        if event_names is not None:
+            m = pc.is_in(table.column("event"),
+                         value_set=pa.array(list(event_names)))
+            mask = m if mask is None else pc.and_(mask, m)
+        if entity_type is not None:
+            m = pc.equal(table.column("entity_type"), entity_type)
+            mask = m if mask is None else pc.and_(mask, m)
+        return table.filter(mask) if mask is not None else table
+
+    def aggregate_properties(self, entity_type: str) -> Dict[str, PropertyMap]:
+        """$set/$unset/$delete fold over the snapshot (PBatchView
+        aggregateProperties parity), reusing the canonical aggregator so the
+        view path and the store path cannot diverge."""
+        rows = self.filtered_table(event_names=AGGREGATOR_EVENT_NAMES,
+                                   entity_type=entity_type)
+        return aggregate_properties(table_to_events(rows))

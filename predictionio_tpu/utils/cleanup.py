@@ -1,0 +1,52 @@
+"""Global at-exit cleanup callback registry.
+
+Parity with the reference's CleanupFunctions
+(core/.../workflow/CleanupFunctions.scala:29-65), used there by the ES storage
+client and pypio to close connections when a workflow ends. The rebuild also
+wires the registry into `atexit` so daemon servers and CLI commands get the
+same guarantee without an explicit run() at every exit path.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import Callable, List
+
+logger = logging.getLogger("pio.cleanup")
+
+_lock = threading.Lock()
+_functions: List[Callable[[], None]] = []
+_atexit_registered = False
+
+
+def add(fn: Callable[[], None]) -> None:
+    """Register a zero-arg cleanup callback (CleanupFunctions.add)."""
+    global _atexit_registered
+    with _lock:
+        _functions.append(fn)
+        if not _atexit_registered:
+            atexit.register(run)
+            _atexit_registered = True
+
+
+def run() -> None:
+    """Run and clear all registered callbacks (CleanupFunctions.run).
+
+    Callbacks run in registration order; failures are logged, not raised, so
+    one bad callback cannot block the rest of shutdown.
+    """
+    with _lock:
+        fns, _functions[:] = list(_functions), []
+    for fn in fns:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 - shutdown must not raise
+            logger.exception("cleanup callback %r failed", fn)
+
+
+def clear() -> None:
+    """Drop registered callbacks without running them (tests)."""
+    with _lock:
+        _functions.clear()

@@ -1,0 +1,148 @@
+"""Tests for EntityMap, CleanupFunctions, and the materialized view layer.
+
+View semantics mirror the reference's DataView.create parquet-cache behavior
+(data/.../view/DataView.scala:36-108) and PBatchView aggregateProperties.
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data import DataMap, EntityMap, Event
+from predictionio_tpu.data.view import BatchView, DataView
+from predictionio_tpu.storage import App, Storage
+from predictionio_tpu.utils import cleanup
+
+UTC = dt.timezone.utc
+T0 = dt.datetime(2024, 1, 1, tzinfo=UTC)
+
+
+# -- EntityMap ---------------------------------------------------------------
+
+def test_entity_map_ids_and_data():
+    em = EntityMap({"b": 20, "a": 10, "c": 30})
+    assert len(em) == 3
+    # BiMap.string_int sorts keys for determinism
+    assert em.entity_int_id("a") == 0
+    assert em.entity_int_id("c") == 2
+    assert em.entity_id_of(1) == "b"
+    assert em["b"] == 20
+    assert em.data_by_int_id(2) == 30
+    assert "a" in em and "z" not in em
+
+
+def test_entity_map_map_values_keeps_id_space():
+    em = EntityMap({"x": 1, "y": 2})
+    doubled = em.map_values(lambda v: v * 2)
+    assert doubled["y"] == 4
+    assert doubled.entity_int_id("x") == em.entity_int_id("x")
+
+
+def test_entity_map_rows_in_int_id_order():
+    em = EntityMap({"m": "M", "k": "K"})
+    rows = list(em.to_rows())
+    assert rows == [("k", 0, "K"), ("m", 1, "M")]
+
+
+# -- CleanupFunctions --------------------------------------------------------
+
+def test_cleanup_runs_in_order_and_clears():
+    cleanup.clear()
+    calls = []
+    cleanup.add(lambda: calls.append(1))
+    cleanup.add(lambda: calls.append(2))
+    cleanup.run()
+    assert calls == [1, 2]
+    cleanup.run()  # registry cleared: no double-run
+    assert calls == [1, 2]
+
+
+def test_cleanup_failure_does_not_block_rest():
+    cleanup.clear()
+    calls = []
+
+    def boom():
+        raise RuntimeError("x")
+
+    cleanup.add(boom)
+    cleanup.add(lambda: calls.append("ok"))
+    cleanup.run()
+    assert calls == ["ok"]
+
+
+# -- DataView / BatchView ----------------------------------------------------
+
+@pytest.fixture()
+def app_with_events(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "v.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    from predictionio_tpu.data.eventstore import clear_cache
+    clear_cache()
+    app_id = Storage.get_meta_data_apps().insert(App(id=0, name="ViewApp"))
+    store = Storage.get_events()
+    store.init_channel(app_id)
+    events = [
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"plan": "free"}), event_time=T0),
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"plan": "pro"}),
+              event_time=T0 + dt.timedelta(days=1)),
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=T0 + dt.timedelta(days=2)),
+        Event(event="buy", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=T0 + dt.timedelta(days=3)),
+    ]
+    store.insert_batch(events, app_id)
+    yield "ViewApp"
+    Storage.reset()
+    clear_cache()
+
+
+def test_dataview_materializes_and_caches(app_with_events, tmp_path):
+    cache = str(tmp_path / "views")
+    view = DataView(app_with_events, cache_dir=cache)
+    table = view.create()
+    assert table.num_rows == 4
+    # second view object with the same key loads from the parquet cache
+    view2 = DataView(app_with_events, cache_dir=cache)
+    assert view2.cache_path == view.cache_path
+    table2 = view2.create()
+    assert table2.num_rows == 4
+
+
+def test_dataview_version_changes_cache_key(app_with_events, tmp_path):
+    cache = str(tmp_path / "views")
+    v0 = DataView(app_with_events, version="0", cache_dir=cache)
+    v1 = DataView(app_with_events, version="1", cache_dir=cache)
+    assert v0.cache_path != v1.cache_path
+
+
+def test_dataview_refresh_sees_new_events(app_with_events, tmp_path):
+    cache = str(tmp_path / "views")
+    view = DataView(app_with_events, cache_dir=cache)
+    assert view.create().num_rows == 4
+    from predictionio_tpu.data.eventstore import resolve_app
+    app_id, _ = resolve_app(app_with_events)
+    Storage.get_events().insert(
+        Event(event="view", entity_type="user", entity_id="u3",
+              target_entity_type="item", target_entity_id="i2",
+              event_time=T0 + dt.timedelta(days=4)), app_id)
+    assert view.create().num_rows == 4          # cached
+    assert view.create(refresh=True).num_rows == 5
+
+
+def test_batchview_filter_and_aggregate(app_with_events, tmp_path):
+    bv = BatchView(app_with_events, cache_dir=str(tmp_path / "views"))
+    views_only = bv.filtered_table(event_names=["view", "buy"])
+    assert views_only.num_rows == 2
+    props = bv.aggregate_properties("user")
+    assert set(props) == {"u1"}
+    assert props["u1"].get("plan") == "pro"   # last-write-wins

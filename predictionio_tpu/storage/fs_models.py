@@ -1,0 +1,45 @@
+"""Model blob store over any fsspec filesystem URL.
+
+One backend replacing the reference's three file-oriented model stores —
+LocalFSModels (storage/localfs/.../LocalFSModels.scala:32-62), HDFSModels
+(storage/hdfs/.../HDFSModels.scala:31-63) and S3Models
+(storage/s3/.../S3Models.scala:36-101) — via fsspec URL schemes: a plain
+path, ``hdfs://``, ``s3://``, ``memory://``. File-per-model, like all three.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.base import Model
+
+
+class FSModels(base.Models):
+    def __init__(self, url: str):
+        import fsspec
+
+        self.url = url
+        self.fs, self.root = fsspec.core.url_to_fs(url)
+        self.fs.makedirs(self.root, exist_ok=True)
+
+    def _path(self, model_id: str) -> str:
+        if "/" in model_id or model_id.startswith("."):
+            raise ValueError(f"invalid model id {model_id!r}")
+        return f"{self.root}/pio_model_{model_id}.bin"
+
+    def insert(self, model: Model) -> None:
+        with self.fs.open(self._path(model.id), "wb") as f:
+            f.write(model.models)
+
+    def get(self, model_id: str) -> Optional[Model]:
+        path = self._path(model_id)
+        if not self.fs.exists(path):
+            return None
+        with self.fs.open(path, "rb") as f:
+            return Model(id=model_id, models=f.read())
+
+    def delete(self, model_id: str) -> None:
+        path = self._path(model_id)
+        if self.fs.exists(path):
+            self.fs.rm(path)

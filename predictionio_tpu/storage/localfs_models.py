@@ -2,39 +2,16 @@
 
 Parity with the reference's localfs backend
 (storage/localfs/.../LocalFSModels.scala:32-62): one file per model id under a
-base directory. Checkpoint directories written by orbax live next to these
-blobs (see workflow/train.py).
+base directory. A thin alias of FSModels — fsspec's local filesystem covers
+plain paths, so localfs and fs share one implementation (and one model-id
+guard). Checkpoint directories written by orbax live next to these blobs
+(see workflow/train.py).
 """
 
 from __future__ import annotations
 
-from pathlib import Path
-from typing import Optional
-
-from predictionio_tpu.storage import base
-from predictionio_tpu.storage.base import Model
+from predictionio_tpu.storage.fs_models import FSModels
 
 
-class LocalFSModels(base.Models):
-    def __init__(self, path: str):
-        self.base = Path(path)
-        self.base.mkdir(parents=True, exist_ok=True)
-
-    def _file(self, model_id: str) -> Path:
-        if "/" in model_id or model_id.startswith("."):
-            raise ValueError(f"invalid model id {model_id!r}")
-        return self.base / f"pio_model_{model_id}.bin"
-
-    def insert(self, model: Model) -> None:
-        self._file(model.id).write_bytes(model.models)
-
-    def get(self, model_id: str) -> Optional[Model]:
-        f = self._file(model_id)
-        if not f.exists():
-            return None
-        return Model(id=model_id, models=f.read_bytes())
-
-    def delete(self, model_id: str) -> None:
-        f = self._file(model_id)
-        if f.exists():
-            f.unlink()
+class LocalFSModels(FSModels):
+    pass

@@ -1,0 +1,307 @@
+"""Columnar event store on parquet fragments over any fsspec filesystem.
+
+The rebuild's analog of the reference's "scalable" event backends — HBase
+(storage/hbase/.../HBEventsUtil.scala:49-408) and the Hadoop-RDD read paths
+(HBPEvents.scala:62-87, ESPEvents.scala:44-141, JDBCPEvents.scala:89-101).
+Where the reference pairs a row store with Hadoop input formats for Spark,
+the TPU-native design stores events directly in the training-path layout:
+append-only parquet fragments per (app, channel) namespace that
+`find_columnar` reads straight into pyarrow tables feeding device arrays
+(SURVEY.md §2.9 P2). One backend covers local disk, memory://, s3:// and
+hdfs:// through fsspec URL schemes — replacing the reference's per-system
+backend zoo (S3Models/HDFSModels/HBase) with one filesystem abstraction.
+
+Writers never contend: every insert batch becomes a uniquely-named fragment,
+so multi-process ingest needs no lock (the object-store-friendly analog of
+HBase's uuid-suffixed rowkeys, HBEventsUtil.scala:76-131).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import uuid
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, millis as _to_ms
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.base import StorageError, UNFILTERED, generate_id
+
+from predictionio_tpu.storage.sqlite_backend import _from_ms, _tz_offset_min
+
+STORE_SCHEMA = pa.schema([
+    ("id", pa.string()),
+    ("event", pa.string()),
+    ("entityType", pa.string()),
+    ("entityId", pa.string()),
+    ("targetEntityType", pa.string()),
+    ("targetEntityId", pa.string()),
+    ("properties", pa.string()),      # JSON or null
+    ("eventTime", pa.int64()),        # epoch millis
+    ("eventTimeZone", pa.int32()),    # UTC offset minutes
+    ("tags", pa.string()),            # comma-joined or null
+    ("prId", pa.string()),
+    ("creationTime", pa.int64()),
+    ("creationTimeZone", pa.int32()),
+])
+
+
+class ParquetEventsClient:
+    """Holds the fsspec filesystem + root path for one source."""
+
+    def __init__(self, url: str):
+        import fsspec
+
+        self.url = url
+        self.fs, self.root = fsspec.core.url_to_fs(url)
+        self.fs.makedirs(self.root, exist_ok=True)
+
+    def close(self) -> None:  # filesystems are process-global; nothing to do
+        pass
+
+
+class ParquetEvents(base.EventStore):
+    """EventStore over append-only parquet fragments."""
+
+    def __init__(self, client: ParquetEventsClient):
+        self.client = client
+
+    # -- namespace lifecycle ------------------------------------------------
+    def _ns(self, app_id: int, channel_id: Optional[int]) -> str:
+        suffix = f"_{channel_id}" if channel_id is not None else ""
+        return f"{self.client.root}/pio_event_{app_id}{suffix}"
+
+    def init_channel(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        ns = self._ns(app_id, channel_id)
+        self.client.fs.makedirs(ns, exist_ok=True)
+        # marker file: an empty namespace is still "initialized"
+        with self.client.fs.open(f"{ns}/_pio_ns", "wb") as f:
+            f.write(b"")
+        return True
+
+    def remove_channel(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        ns = self._ns(app_id, channel_id)
+        if self.client.fs.exists(ns):
+            self.client.fs.rm(ns, recursive=True)
+        return True
+
+    def close(self) -> None:
+        self.client.close()
+
+    def _check_ns(self, app_id: int, channel_id: Optional[int]) -> str:
+        ns = self._ns(app_id, channel_id)
+        if not self.client.fs.exists(f"{ns}/_pio_ns"):
+            raise StorageError(
+                f"cannot access app {app_id} channel {channel_id}: namespace "
+                "not initialized. Was the app initialized (pio app new)?")
+        return ns
+
+    def _fragments(self, ns: str) -> List[str]:
+        return sorted(self.client.fs.glob(f"{ns}/part-*.parquet"))
+
+    # -- CRUD ---------------------------------------------------------------
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        ns = self._check_ns(app_id, channel_id)
+        cols = {name: [] for name in STORE_SCHEMA.names}
+        ids = []
+        for e in events:
+            eid = e.event_id or generate_id()
+            ids.append(eid)
+            cols["id"].append(eid)
+            cols["event"].append(e.event)
+            cols["entityType"].append(e.entity_type)
+            cols["entityId"].append(e.entity_id)
+            cols["targetEntityType"].append(e.target_entity_type)
+            cols["targetEntityId"].append(e.target_entity_id)
+            cols["properties"].append(
+                e.properties.to_json() if not e.properties.is_empty else None)
+            cols["eventTime"].append(_to_ms(e.event_time))
+            cols["eventTimeZone"].append(_tz_offset_min(e.event_time))
+            cols["tags"].append(",".join(e.tags) if e.tags else None)
+            cols["prId"].append(e.pr_id)
+            cols["creationTime"].append(_to_ms(e.creation_time))
+            cols["creationTimeZone"].append(_tz_offset_min(e.creation_time))
+        table = pa.table(cols, schema=STORE_SCHEMA)
+        self._write_fragment(ns, table)
+        return ids
+
+    def _write_fragment(self, ns: str, table: pa.Table) -> None:
+        path = f"{ns}/part-{uuid.uuid4().hex}.parquet"
+        with self.client.fs.open(path, "wb") as f:
+            pq.write_table(table, f)
+
+    def _read_all(self, ns: str) -> pa.Table:
+        frags = self._fragments(ns)
+        if not frags:
+            return STORE_SCHEMA.empty_table()
+        tables = []
+        for path in frags:
+            with self.client.fs.open(path, "rb") as f:
+                tables.append(pq.read_table(f))
+        t = pa.concat_tables(tables)
+        dead = self._tombstones(ns)
+        if dead:
+            t = t.filter(pc.invert(pc.is_in(
+                t.column("id"), value_set=pa.array(sorted(dead)))))
+        return t
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        ns = self._check_ns(app_id, channel_id)
+        if event_id in self._tombstones(ns):
+            return None
+        for path in self._fragments(ns):
+            with self.client.fs.open(path, "rb") as f:
+                t = pq.read_table(f)
+            t = t.filter(pc.equal(t.column("id"), event_id))
+            if t.num_rows:
+                return _row_to_event(t.to_pylist()[0])
+        return None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        """Tombstone the id: fragments stay append-only and immutable, so a
+        crash can never lose unrelated rows (the object-store-safe delete;
+        compaction can fold tombstones in later)."""
+        ns = self._check_ns(app_id, channel_id)
+        if self.get(event_id, app_id, channel_id) is None:
+            return False
+        with self.client.fs.open(
+                f"{ns}/tomb-{uuid.uuid4().hex}", "wb") as f:
+            f.write(event_id.encode())
+        return True
+
+    def _tombstones(self, ns: str) -> set:
+        ids = set()
+        for path in self.client.fs.glob(f"{ns}/tomb-*"):
+            with self.client.fs.open(path, "rb") as f:
+                ids.add(f.read().decode())
+        return ids
+
+    # -- queries ------------------------------------------------------------
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type=UNFILTERED,
+        target_entity_id=UNFILTERED,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> pa.Table:
+        """Vectorized filter over all fragments — the training hot path."""
+        ns = self._check_ns(app_id, channel_id)
+        t = self._filter_rows(
+            self._read_all(ns), start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id)
+        if t.num_rows:
+            t = t.sort_by([("eventTime",
+                            "descending" if reversed_order else "ascending")])
+        if limit is not None and limit >= 0:
+            t = t.slice(0, limit)
+        return _to_columnar(t)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type=UNFILTERED,
+        target_entity_id=UNFILTERED,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]:
+        ns = self._check_ns(app_id, channel_id)
+        t = self._read_all(ns)
+        # reuse the columnar filter by re-reading filtered rows as events
+        filtered = self._filter_rows(
+            t, start_time, until_time, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id)
+        filtered = filtered.sort_by(
+            [("eventTime", "descending" if reversed_order else "ascending")])
+        if limit is not None and limit >= 0:
+            filtered = filtered.slice(0, limit)
+        for row in filtered.to_pylist():
+            yield _row_to_event(row)
+
+    def _filter_rows(self, t, start_time, until_time, entity_type, entity_id,
+                     event_names, target_entity_type, target_entity_id):
+        if not t.num_rows:
+            return t
+        mask = pa.array(np.ones(t.num_rows, dtype=bool))
+        if start_time is not None:
+            mask = pc.and_(mask, pc.greater_equal(
+                t.column("eventTime"), _to_ms(start_time)))
+        if until_time is not None:
+            mask = pc.and_(mask, pc.less(
+                t.column("eventTime"), _to_ms(until_time)))
+        if entity_type is not None:
+            mask = pc.and_(mask, pc.equal(t.column("entityType"), entity_type))
+        if entity_id is not None:
+            mask = pc.and_(mask, pc.equal(t.column("entityId"), entity_id))
+        if event_names:
+            mask = pc.and_(mask, pc.is_in(
+                t.column("event"), value_set=pa.array(list(event_names))))
+        if target_entity_type is not UNFILTERED:
+            col = t.column("targetEntityType")
+            m = (pc.is_null(col) if target_entity_type is None
+                 else pc.equal(col, target_entity_type))
+            mask = pc.and_(mask, pc.fill_null(m, False))
+        if target_entity_id is not UNFILTERED:
+            col = t.column("targetEntityId")
+            m = (pc.is_null(col) if target_entity_id is None
+                 else pc.equal(col, target_entity_id))
+            mask = pc.and_(mask, pc.fill_null(m, False))
+        return t.filter(mask)
+
+
+def _to_columnar(t: pa.Table) -> pa.Table:
+    """Store schema -> the shared columnar EVENT_SCHEMA layout
+    (data/columnar.py) consumed by DataSources."""
+    return pa.table({
+        "event_id": t.column("id"),
+        "event": t.column("event"),
+        "entity_type": t.column("entityType"),
+        "entity_id": t.column("entityId"),
+        "target_entity_type": t.column("targetEntityType"),
+        "target_entity_id": t.column("targetEntityId"),
+        "properties": t.column("properties"),
+        "event_time_ms": t.column("eventTime"),
+        "creation_time_ms": t.column("creationTime"),
+    })
+
+
+def _row_to_event(row: dict) -> Event:
+    return Event(
+        event_id=row["id"],
+        event=row["event"],
+        entity_type=row["entityType"],
+        entity_id=row["entityId"],
+        target_entity_type=row["targetEntityType"],
+        target_entity_id=row["targetEntityId"],
+        properties=(DataMap(json.loads(row["properties"]))
+                    if row["properties"] else DataMap()),
+        event_time=_from_ms(row["eventTime"], row["eventTimeZone"]),
+        tags=tuple(row["tags"].split(",")) if row["tags"] else (),
+        pr_id=row["prId"],
+        creation_time=_from_ms(row["creationTime"], row["creationTimeZone"]),
+    )

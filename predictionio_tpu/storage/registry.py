@@ -2,9 +2,17 @@
 
 Parity with the reference's Storage object (data/.../storage/Storage.scala:146-466):
 
-  * ``PIO_STORAGE_SOURCES_<NAME>_TYPE``  — backend type of source <NAME>
-    (rebuild types: ``sqlite``, ``localfs``; the reference's jdbc/hbase/
-    elasticsearch/s3/hdfs map onto these or are future backends)
+  * ``PIO_STORAGE_SOURCES_<NAME>_TYPE``  — backend type of source <NAME>.
+    Rebuild types and their reference counterparts:
+      - ``sqlite``   — dev default (reference: jdbc/H2 test mode)
+      - ``postgres`` — production SQL (reference: jdbc PostgreSQL/MySQL);
+        gated on a driver being installed
+      - ``parquet``  — columnar event fragments over any fsspec URL
+        (reference: hbase/elasticsearch scalable event stores + their
+        Hadoop-RDD read paths); PATH may be a dir, s3:// or hdfs://
+      - ``localfs``  — file-per-model (reference: localfs)
+      - ``fs``       — model store over any fsspec URL (reference:
+        hdfs/s3 model stores)
   * ``PIO_STORAGE_SOURCES_<NAME>_PATH`` — backend-specific location
   * ``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}``
     — binds each repository to a source
@@ -141,8 +149,16 @@ class Storage:
             if stype == "sqlite":
                 from predictionio_tpu.storage.sqlite_backend import SqliteClient
                 client = SqliteClient(conf.get("PATH", ":memory:"))
-            elif stype == "localfs":
-                client = conf  # localfs needs no client beyond its config
+            elif stype == "postgres":
+                from predictionio_tpu.storage.postgres_backend import PostgresClient
+                client = PostgresClient(conf.get("URL", conf.get("PATH", "")))
+            elif stype == "parquet":
+                from predictionio_tpu.storage.parquet_events import (
+                    ParquetEventsClient)
+                client = ParquetEventsClient(
+                    conf.get("PATH", os.path.join(_DEFAULT_HOME, "events")))
+            elif stype in ("localfs", "fs"):
+                client = conf  # path-configured; no connection to manage
             else:
                 raise StorageError(f"unknown storage type {stype!r} "
                                    f"for source {source_name}")
@@ -224,9 +240,31 @@ def _construct(stype: str, kind: str, client, source_conf: Dict[str, str]):
             "events": sb.SqliteEvents,
         }
         return ctors[kind](client)
+    if stype == "postgres":
+        from predictionio_tpu.storage import postgres_backend as pg
+        ctors = {
+            "apps": pg.PostgresApps,
+            "accesskeys": pg.PostgresAccessKeys,
+            "channels": pg.PostgresChannels,
+            "engineinstances": pg.PostgresEngineInstances,
+            "evaluationinstances": pg.PostgresEvaluationInstances,
+            "models": pg.PostgresModels,
+            "events": pg.PostgresEvents,
+        }
+        return ctors[kind](client)
+    if stype == "parquet":
+        if kind != "events":
+            raise StorageError("parquet source only supports EVENTDATA")
+        from predictionio_tpu.storage.parquet_events import ParquetEvents
+        return ParquetEvents(client)
     if stype == "localfs":
         if kind != "models":
             raise StorageError("localfs source only supports MODELDATA")
         from predictionio_tpu.storage.localfs_models import LocalFSModels
         return LocalFSModels(source_conf.get("PATH", os.path.join(_DEFAULT_HOME, "models")))
+    if stype == "fs":
+        if kind != "models":
+            raise StorageError("fs source only supports MODELDATA")
+        from predictionio_tpu.storage.fs_models import FSModels
+        return FSModels(source_conf.get("PATH", os.path.join(_DEFAULT_HOME, "models")))
     raise StorageError(f"unknown storage type {stype!r}")

@@ -15,6 +15,8 @@ from predictionio_tpu.storage import (
     AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
     Storage, StorageError, UNFILTERED,
 )
+from predictionio_tpu.storage.parquet_events import (
+    ParquetEvents, ParquetEventsClient)
 from predictionio_tpu.storage.sqlite_backend import SqliteClient, SqliteEvents
 
 UTC = dt.timezone.utc
@@ -25,11 +27,14 @@ def t(days):
     return T0 + dt.timedelta(days=days)
 
 
-@pytest.fixture()
-def store(tmp_path):
-    """Parametrized over backends as more land; sqlite-file for now."""
-    client = SqliteClient(str(tmp_path / "events.db"))
-    s = SqliteEvents(client)
+@pytest.fixture(params=["sqlite", "parquet"])
+def store(tmp_path, request):
+    """One shared behavioral contract, run against every event backend
+    (the reference's LEventsSpec/PEventsSpec pattern)."""
+    if request.param == "sqlite":
+        s = SqliteEvents(SqliteClient(str(tmp_path / "events.db")))
+    else:
+        s = ParquetEvents(ParquetEventsClient(str(tmp_path / "events_pq")))
     s.init_channel(1)
     yield s
     s.close()
@@ -298,3 +303,100 @@ def test_event_store_facade(meta):
     with pytest.raises(StorageError):
         list(EventStoreClient.find("nonexistent-app"))
     clear_cache()
+
+
+# -- new backends: fs models, parquet via registry, postgres gating ---------
+
+def test_fs_models_memory_and_local(tmp_path):
+    from predictionio_tpu.storage.fs_models import FSModels
+    for url in (str(tmp_path / "fsmodels"), "memory://pio-test-models"):
+        ms = FSModels(url)
+        ms.insert(Model(id="m1", models=b"\x00blob\xff"))
+        assert ms.get("m1").models == b"\x00blob\xff"
+        ms.insert(Model(id="m1", models=b"v2"))
+        assert ms.get("m1").models == b"v2"
+        ms.delete("m1")
+        assert ms.get("m1") is None
+
+
+def test_postgres_backend_gated_without_driver():
+    from predictionio_tpu.storage.postgres_backend import PostgresClient
+    with pytest.raises(StorageError, match="psycopg2 or pg8000"):
+        PostgresClient("postgresql://localhost/pio")
+
+
+def test_registry_parquet_eventdata_fs_modeldata(tmp_path):
+    Storage.configure({
+        "sources": {
+            "PQ": {"TYPE": "parquet", "PATH": str(tmp_path / "ev")},
+            "META": {"TYPE": "sqlite", "PATH": str(tmp_path / "meta.db")},
+            "FS": {"TYPE": "fs", "PATH": str(tmp_path / "models")},
+        },
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "META"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "PQ"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "FS"},
+        },
+    })
+    try:
+        assert Storage.verify_all_data_objects() is True
+        events = Storage.get_events()
+        events.init_channel(7)
+        events.insert_batch([ev(0), ev(1, name="buy")], 7)
+        table = events.find_columnar(7)
+        assert table.num_rows == 2
+        assert table.column("event").to_pylist() == ["view", "buy"]
+        Storage.get_model_data_models().insert(Model(id="x", models=b"b"))
+        assert Storage.get_model_data_models().get("x").models == b"b"
+    finally:
+        Storage.reset()
+
+
+def test_parquet_multiprocess_style_appends(tmp_path):
+    """Two independent store objects over the same path see each other's
+    fragments (the lock-free multi-writer property)."""
+    url = str(tmp_path / "shared")
+    s1 = ParquetEvents(ParquetEventsClient(url))
+    s1.init_channel(1)
+    s2 = ParquetEvents(ParquetEventsClient(url))
+    s1.insert(ev(0), 1)
+    s2.insert(ev(1, eid="u2"), 1)
+    assert len(list(s1.find(1))) == 2
+    assert len(list(s2.find(1))) == 2
+
+
+def test_parquet_delete_is_crash_safe_tombstone(tmp_path):
+    """Delete never rewrites fragments; unrelated rows in the same fragment
+    survive, and the id stays gone across fresh store objects."""
+    url = str(tmp_path / "tomb")
+    s = ParquetEvents(ParquetEventsClient(url))
+    s.init_channel(1)
+    ids = s.insert_batch([ev(0), ev(1, eid="u2"), ev(2, eid="u3")], 1)  # one fragment
+    assert s.delete(ids[1], 1) is True
+    assert s.get(ids[1], 1) is None
+    remaining = {e.entity_id for e in s.find(1)}
+    assert remaining == {"u1", "u3"}
+    # a fresh client over the same path sees the tombstone too
+    s2 = ParquetEvents(ParquetEventsClient(url))
+    assert s2.get(ids[1], 1) is None
+    assert len(list(s2.find(1))) == 2
+
+
+def test_parquet_find_columnar_limit_and_order(tmp_path):
+    s = ParquetEvents(ParquetEventsClient(str(tmp_path / "lim")))
+    s.init_channel(1)
+    s.insert_batch([ev(0), ev(1), ev(2)], 1)
+    t_lim = s.find_columnar(1, limit=2)
+    assert t_lim.num_rows == 2
+    t_rev = s.find_columnar(1, reversed_order=True)
+    times = t_rev.column("event_time_ms").to_pylist()
+    assert times == sorted(times, reverse=True)
+
+
+def test_fs_models_rejects_traversal_ids(tmp_path):
+    from predictionio_tpu.storage.fs_models import FSModels
+    ms = FSModels(str(tmp_path / "guard"))
+    with pytest.raises(ValueError):
+        ms.insert(Model(id="../escape", models=b"x"))
+    with pytest.raises(ValueError):
+        ms.get(".hidden")

@@ -131,5 +131,11 @@ def create_admin_server() -> web.Application:
 
 
 def run_admin_server(ip: str = "localhost", port: int = DEFAULT_PORT) -> None:
-    logger.info("Admin API listening on %s:%s", ip, port)
-    web.run_app(create_admin_server(), host=ip, port=port, print=None)
+    from predictionio_tpu.utils.server_config import ServerConfig
+
+    cfg = ServerConfig.load()
+    ssl_ctx = cfg.ssl_context()
+    logger.info("Admin API listening on %s:%s%s", ip, port,
+                " (TLS)" if ssl_ctx else "")
+    web.run_app(create_admin_server(), host=ip, port=port,
+                ssl_context=ssl_ctx, print=None)

@@ -3,21 +3,35 @@
 Parity with the reference Dashboard (tools/.../dashboard/Dashboard.scala:45-162):
 an HTML index of completed EvaluationInstances (newest first) with per-instance
 detail pages rendering the stored evaluator HTML, plus JSON endpoints for
-programmatic access.
+programmatic access. Optional key auth + TLS come from the server config
+(the reference's with-key-auth SSL dashboard, Dashboard.scala:65+ /
+KeyAuthentication.scala:33-62).
 """
 
 from __future__ import annotations
 
 import html
 import logging
+from typing import Optional
 
 from aiohttp import web
 
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.utils.server_config import ServerConfig
 
 logger = logging.getLogger("pio.dashboard")
 
 DEFAULT_PORT = 9000
+
+_SERVER_CONFIG = web.AppKey("server_config", ServerConfig)
+
+
+@web.middleware
+async def _key_auth_middleware(request, handler):
+    cfg = request.app[_SERVER_CONFIG]
+    if not cfg.check_key(request.query.get("accessKey")):
+        return web.json_response({"message": "Unauthorized"}, status=401)
+    return await handler(request)
 
 
 def _index_html(instances) -> str:
@@ -76,8 +90,10 @@ async def handle_detail_json(request):
     })
 
 
-def create_dashboard() -> web.Application:
-    app = web.Application()
+def create_dashboard(server_config: Optional[ServerConfig] = None
+                     ) -> web.Application:
+    app = web.Application(middlewares=[_key_auth_middleware])
+    app[_SERVER_CONFIG] = server_config or ServerConfig()
     app.router.add_get("/", handle_index)
     app.router.add_get("/engine_instances/{instance_id}", handle_detail)
     app.router.add_get("/evaluations.json", handle_index_json)
@@ -85,6 +101,11 @@ def create_dashboard() -> web.Application:
     return app
 
 
-def run_dashboard(ip: str = "localhost", port: int = DEFAULT_PORT) -> None:
-    logger.info("Dashboard listening on %s:%s", ip, port)
-    web.run_app(create_dashboard(), host=ip, port=port, print=None)
+def run_dashboard(ip: str = "localhost", port: int = DEFAULT_PORT,
+                  server_config: Optional[ServerConfig] = None) -> None:
+    cfg = server_config or ServerConfig.load()
+    ssl_ctx = cfg.ssl_context()
+    logger.info("Dashboard listening on %s:%s%s", ip, port,
+                " (TLS)" if ssl_ctx else "")
+    web.run_app(create_dashboard(cfg), host=ip, port=port,
+                ssl_context=ssl_ctx, print=None)

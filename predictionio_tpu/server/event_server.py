@@ -347,6 +347,11 @@ def create_event_server(stats: bool = False,
 def run_event_server(ip: str = "localhost", port: int = DEFAULT_PORT,
                      stats: bool = False) -> None:
     """Standalone entry (EventServer Run.main:552)."""
+    from predictionio_tpu.utils.server_config import ServerConfig
+
+    cfg = ServerConfig.load()
     app = create_event_server(stats=stats)
-    logger.info("Event Server listening on %s:%s", ip, port)
-    web.run_app(app, host=ip, port=port, print=None)
+    ssl_ctx = cfg.ssl_context()
+    logger.info("Event Server listening on %s:%s%s", ip, port,
+                " (TLS)" if ssl_ctx else "")
+    web.run_app(app, host=ip, port=port, ssl_context=ssl_ctx, print=None)

@@ -254,6 +254,15 @@ def run_query_server(engine: Engine, train_result: TrainResult,
                      instance: EngineInstance, ctx,
                      ip: str = "localhost", port: int = DEFAULT_PORT,
                      **kwargs) -> None:
+    from predictionio_tpu.utils.server_config import ServerConfig
+
+    cfg = ServerConfig.load()
+    # server.conf key guards /stop and /reload when no explicit key given
+    # (CreateServer + KeyAuthentication.scala:33-62)
+    kwargs.setdefault("access_key", cfg.key or None)
     server = create_query_server(engine, train_result, instance, ctx, **kwargs)
-    logger.info("Query server listening on %s:%s", ip, port)
-    web.run_app(server.app, host=ip, port=port, print=None)
+    ssl_ctx = cfg.ssl_context()
+    logger.info("Query server listening on %s:%s%s", ip, port,
+                " (TLS)" if ssl_ctx else "")
+    web.run_app(server.app, host=ip, port=port,
+                ssl_context=ssl_ctx, print=None)

@@ -132,9 +132,37 @@ class ParquetEvents(base.EventStore):
             cols["prId"].append(e.pr_id)
             cols["creationTime"].append(_to_ms(e.creation_time))
             cols["creationTimeZone"].append(_tz_offset_min(e.creation_time))
-        table = pa.table(cols, schema=STORE_SCHEMA)
-        self._write_fragment(ns, table)
+        # caller-supplied ids may reuse a previously-deleted id; scrub the
+        # dead physical rows and their tombstones first so delete-then-
+        # reinsert matches the SQL backends (event visible again, once).
+        # Fresh generated ids can never collide, so the common path skips it.
+        provided = {e.event_id for e in events if e.event_id}
+        if provided:
+            self._scrub(ns, provided & self._tombstones(ns))
+        self._write_fragment(ns, pa.table(cols, schema=STORE_SCHEMA))
         return ids
+
+    def _scrub(self, ns: str, dead_ids: set) -> None:
+        """Physically drop rows with `dead_ids` and their tombstone files.
+        New replacement fragments are written before old ones are removed, so
+        a crash can duplicate-but-never-lose unrelated rows."""
+        if not dead_ids:
+            return
+        value_set = pa.array(sorted(dead_ids))
+        for path in self._fragments(ns):
+            with self.client.fs.open(path, "rb") as f:
+                t = pq.read_table(f)
+            mask = pc.is_in(t.column("id"), value_set=value_set)
+            if not pc.any(mask).as_py():
+                continue
+            kept = t.filter(pc.invert(mask))
+            if kept.num_rows:
+                self._write_fragment(ns, kept)
+            self.client.fs.rm(path)
+        for path in self.client.fs.glob(f"{ns}/tomb-*"):
+            with self.client.fs.open(path, "rb") as f:
+                if f.read().decode() in dead_ids:
+                    self.client.fs.rm(path)
 
     def _write_fragment(self, ns: str, table: pa.Table) -> None:
         path = f"{ns}/part-{uuid.uuid4().hex}.parquet"

@@ -76,6 +76,8 @@ class PostgresClient:
 
     def __init__(self, url: str):
         self._driver, self.driver_name = _load_driver()
+        #: the driver's DB-API IntegrityError, for duplicate-key handling
+        self.integrity_error = self._driver.IntegrityError
         self.url = url
         self._local = threading.local()
         self._lock = threading.Lock()
@@ -87,6 +89,9 @@ class PostgresClient:
                 c = self._driver.connect(**_url_to_kwargs(self.url))
             else:
                 c = self._driver.connect(self.url)
+            # autocommit: read paths never pin an 'idle in transaction'
+            # connection (which would block autovacuum/DDL indefinitely)
+            c.autocommit = True
             self._local.conn = c
         return c
 
@@ -112,7 +117,8 @@ class PostgresClient:
         return cur
 
     def commit(self) -> None:
-        self.conn().commit()
+        # no-op under autocommit; kept so callers read naturally
+        pass
 
 
 _EVENT_COLS = ("id, event, entityType, entityId, targetEntityType, "
@@ -299,8 +305,7 @@ class PostgresApps(_PgMetaBase, base.Apps):
                 "INSERT INTO pio_apps (id, name, description) VALUES (%s,%s,%s)",
                 (app.id, app.name, app.description))
             return app.id
-        except Exception:
-            self.client.conn().rollback()
+        except self.client.integrity_error:
             return None
 
     def get(self, app_id: int) -> Optional[App]:
@@ -337,8 +342,7 @@ class PostgresAccessKeys(_PgMetaBase, base.AccessKeys):
         try:
             self._exec("INSERT INTO pio_accesskeys VALUES (%s,%s,%s)",
                        (key, k.appid, ",".join(k.events)))
-        except Exception:
-            self.client.conn().rollback()
+        except self.client.integrity_error:
             return None
         return key
 
@@ -391,8 +395,7 @@ class PostgresChannels(_PgMetaBase, base.Channels):
                 "INSERT INTO pio_channels (id, name, appid) VALUES (%s,%s,%s)",
                 (channel.id, channel.name, channel.appid))
             return channel.id
-        except Exception:
-            self.client.conn().rollback()
+        except self.client.integrity_error:
             return None
 
     def get(self, channel_id: int) -> Optional[Channel]:

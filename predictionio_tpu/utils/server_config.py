@@ -1,0 +1,88 @@
+"""Server security configuration: key auth + TLS from a config file.
+
+Parity with the reference's common/ module:
+  * KeyAuthentication (common/.../authentication/KeyAuthentication.scala:33-62)
+    — servers accept an ``accessKey`` query parameter checked against a key
+    configured in ``server.conf`` (``ServerKey`` at :35).
+  * SSLConfiguration (common/.../configuration/SSLConfiguration.scala:26-56)
+    — builds the TLS context for HTTPS servers. The reference reads a JKS
+    keystore; the rebuild reads PEM cert/key paths (the Python-native
+    equivalent) into an ``ssl.SSLContext``.
+
+Config file: ``$PIO_CONF_DIR/server.json`` (or the path in
+``PIO_SERVER_CONF``), JSON shape::
+
+    {"key": "<accessKey or empty>",
+     "ssl": {"enabled": false, "certfile": "...", "keyfile": "..."}}
+
+All fields optional; env vars ``PIO_SERVER_KEY`` / ``PIO_SSL_CERTFILE`` /
+``PIO_SSL_KEYFILE`` override file values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hmac
+import json
+import logging
+import os
+import ssl
+from typing import Optional
+
+from predictionio_tpu.utils.config import pio_home
+
+logger = logging.getLogger("pio.serverconfig")
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    key: str = ""
+    ssl_enabled: bool = False
+    certfile: Optional[str] = None
+    keyfile: Optional[str] = None
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "ServerConfig":
+        """Read server.json, overlay env vars; missing file -> defaults."""
+        if path is None:
+            conf_dir = os.environ.get(
+                "PIO_CONF_DIR", os.path.join(pio_home(), "conf"))
+            path = os.environ.get("PIO_SERVER_CONF",
+                                  os.path.join(conf_dir, "server.json"))
+        data = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                logger.warning("cannot read server config %s: %s", path, e)
+        ssl_conf = data.get("ssl", {}) or {}
+        cfg = cls(
+            key=data.get("key", "") or "",
+            ssl_enabled=bool(ssl_conf.get("enabled", False)),
+            certfile=ssl_conf.get("certfile"),
+            keyfile=ssl_conf.get("keyfile"),
+        )
+        if os.environ.get("PIO_SERVER_KEY"):
+            cfg.key = os.environ["PIO_SERVER_KEY"]
+        if os.environ.get("PIO_SSL_CERTFILE"):
+            cfg.certfile = os.environ["PIO_SSL_CERTFILE"]
+            cfg.ssl_enabled = True
+        if os.environ.get("PIO_SSL_KEYFILE"):
+            cfg.keyfile = os.environ["PIO_SSL_KEYFILE"]
+        return cfg
+
+    def check_key(self, provided: Optional[str]) -> bool:
+        """KeyAuthentication.withAccessKeyFromFile parity: no configured key
+        means open access; otherwise the query param must match."""
+        if not self.key:
+            return True
+        return hmac.compare_digest(provided or "", self.key)
+
+    def ssl_context(self) -> Optional[ssl.SSLContext]:
+        """SSLConfiguration.sslContext parity (PEM instead of JKS)."""
+        if not (self.ssl_enabled and self.certfile and self.keyfile):
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile=self.certfile, keyfile=self.keyfile)
+        return ctx

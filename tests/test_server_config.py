@@ -1,0 +1,97 @@
+"""ServerConfig (key auth + TLS) tests.
+
+Covers the rebuild of KeyAuthentication.scala:33-62 and
+SSLConfiguration.scala:26-56 plus the dashboard auth middleware.
+"""
+
+import json
+import ssl
+import subprocess
+
+import pytest
+
+from predictionio_tpu.utils.server_config import ServerConfig
+
+pytestmark = pytest.mark.anyio
+
+
+def test_load_missing_file_defaults(tmp_path, monkeypatch):
+    monkeypatch.delenv("PIO_SERVER_KEY", raising=False)
+    monkeypatch.setenv("PIO_SERVER_CONF", str(tmp_path / "absent.json"))
+    cfg = ServerConfig.load()
+    assert cfg.key == ""
+    assert cfg.check_key(None) is True       # open access without a key
+    assert cfg.ssl_context() is None
+
+
+def test_load_file_and_env_overlay(tmp_path, monkeypatch):
+    conf = tmp_path / "server.json"
+    conf.write_text(json.dumps({
+        "key": "filekey",
+        "ssl": {"enabled": True, "certfile": "c.pem", "keyfile": "k.pem"}}))
+    monkeypatch.setenv("PIO_SERVER_CONF", str(conf))
+    monkeypatch.delenv("PIO_SERVER_KEY", raising=False)
+    cfg = ServerConfig.load()
+    assert cfg.key == "filekey"
+    assert cfg.ssl_enabled and cfg.certfile == "c.pem"
+    monkeypatch.setenv("PIO_SERVER_KEY", "envkey")
+    assert ServerConfig.load().key == "envkey"
+
+
+def test_check_key():
+    cfg = ServerConfig(key="sekrit")
+    assert cfg.check_key("sekrit") is True
+    assert cfg.check_key("wrong") is False
+    assert cfg.check_key(None) is False
+
+
+def test_ssl_context_from_self_signed_cert(tmp_path):
+    cert, key = tmp_path / "c.pem", tmp_path / "k.pem"
+    p = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        capture_output=True)
+    if p.returncode != 0:
+        pytest.skip("openssl unavailable")
+    cfg = ServerConfig(ssl_enabled=True, certfile=str(cert), keyfile=str(key))
+    ctx = cfg.ssl_context()
+    assert isinstance(ctx, ssl.SSLContext)
+
+
+@pytest.fixture()
+def mem_storage(tmp_path):
+    from predictionio_tpu.storage import Storage
+
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "sc.db")}},
+        "repositories": {
+            r: {"NAME": "pio", "SOURCE": "DB"}
+            for r in ("METADATA", "EVENTDATA", "MODELDATA")},
+    })
+    yield Storage
+    Storage.reset()
+
+
+async def test_dashboard_key_auth(mem_storage):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from predictionio_tpu.server.dashboard import create_dashboard
+
+    c = TestClient(TestServer(create_dashboard(ServerConfig(key="dashkey"))))
+    await c.start_server()
+    try:
+        assert (await c.get("/evaluations.json")).status == 401
+        assert (await c.get("/evaluations.json?accessKey=wrong")).status == 401
+        resp = await c.get("/evaluations.json?accessKey=dashkey")
+        assert resp.status == 200
+        assert await resp.json() == []
+    finally:
+        await c.close()
+    # no key configured -> open access
+    c = TestClient(TestServer(create_dashboard(ServerConfig())))
+    await c.start_server()
+    try:
+        assert (await c.get("/")).status == 200
+    finally:
+        await c.close()

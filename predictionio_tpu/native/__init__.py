@@ -1,0 +1,13 @@
+"""Native (C++) runtime components with pure-Python fallbacks.
+
+The compute path of the framework is JAX/XLA/Pallas; this package holds the
+native pieces of the runtime *around* it — currently the evlog append-only
+event-log codec (native/evlog.cc), compiled on demand with g++ and loaded
+via ctypes.
+"""
+
+from predictionio_tpu.native.evlog import (  # noqa: F401
+    EvlogCodec,
+    entity_hash,
+    get_codec,
+)

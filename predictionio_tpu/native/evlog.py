@@ -1,0 +1,275 @@
+"""evlog codec: ctypes bindings for libpioevlog with a pure-Python twin.
+
+File format (see native/evlog.cc for the authoritative description):
+
+  header : magic ``PIOEVLG1`` | u32 version=1 | u32 reserved   (16 bytes)
+  record : u32 payload_len | u32 crc32 | i64 time_ms | u64 entity_hash
+         | u8 flags (bit0 = tombstone) | 16-byte event id | payload
+  crc32 (zlib polynomial) covers time_ms..payload, little-endian throughout.
+
+The C++ library is compiled from native/evlog.cc on first use (g++, cached
+under the package dir) — the runtime analog of the reference's sbt-built
+storage backend jars. When no compiler is available the PyCodec implements
+the identical format with struct+zlib, so files are always interchangeable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+import zlib
+from typing import List, Optional, Tuple
+
+MAGIC = b"PIOEVLG1"
+VERSION = 1
+HEADER = MAGIC + struct.pack("<II", VERSION, 0)
+_REC_HEAD = struct.Struct("<IIqQB16s")   # len, crc, time_ms, hash, flags, id
+REC_HEAD_SIZE = _REC_HEAD.size           # 41
+TOMBSTONE = 1
+
+T_MIN = -(2 ** 63)
+T_MAX = 2 ** 63 - 1
+
+#: record tuple: (time_ms, entity_hash, flags, id bytes[16], payload bytes)
+Record = Tuple[int, int, int, bytes, bytes]
+
+
+def entity_hash(entity_type: str, entity_id: str) -> int:
+    """FNV-1a 64 of 'entityType\\0entityId' — matches evlog_entity_hash.
+
+    The evlog analog of HBase's rowkey entity prefix
+    (HBEventsUtil.scala:76-131: MD5(entityType-entityId) prefix scans).
+    """
+    h = 1469598103934665603
+    for b in entity_type.encode() + b"\x00" + entity_id.encode():
+        h ^= b
+        h = (h * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h or 1   # 0 is the "no filter" sentinel
+
+
+class EvlogError(Exception):
+    pass
+
+
+class _CodecBase:
+    """Shared record pack/unpack helpers."""
+
+    @staticmethod
+    def pack_record(time_ms: int, ehash: int, flags: int, rid: bytes,
+                    payload: bytes) -> bytes:
+        body = struct.pack("<qQB16s", time_ms, ehash, flags, rid) + payload
+        return struct.pack("<II", len(payload), zlib.crc32(body)) + body
+
+    @staticmethod
+    def unpack_records(buf: bytes) -> List[Record]:
+        out: List[Record] = []
+        off = 0
+        n = len(buf)
+        while off + REC_HEAD_SIZE <= n:
+            plen, _crc, t, h, flags, rid = _REC_HEAD.unpack_from(buf, off)
+            start = off + REC_HEAD_SIZE
+            if start + plen > n:
+                break
+            out.append((t, h, flags, rid, buf[start:start + plen]))
+            off = start + plen
+        return out
+
+
+class PyCodec(_CodecBase):
+    """Pure-Python implementation of the evlog format."""
+
+    name = "python"
+
+    def create(self, path: str) -> None:
+        try:
+            with open(path, "xb") as f:
+                f.write(HEADER)
+        except FileExistsError:
+            pass   # idempotent, like the native codec's EEXIST -> ok
+
+    def append(self, path: str, records: List[Record]) -> None:
+        buf = b"".join(
+            self.pack_record(t, h, flags, rid, payload)
+            for (t, h, flags, rid, payload) in records)
+        try:
+            # r+b (not ab): appending must never create a header-less file
+            with open(path, "r+b") as f:
+                f.seek(0, os.SEEK_END)
+                f.write(buf)
+        except FileNotFoundError as ex:
+            raise EvlogError(f"{path}: no such evlog") from ex
+
+    def scan(self, path: str, t_lo: int = T_MIN, t_hi: int = T_MAX,
+             ehash: int = 0, rid: Optional[bytes] = None) -> List[Record]:
+        with open(path, "rb") as f:
+            data = f.read()
+        if len(data) < len(HEADER) or data[:8] != MAGIC:
+            raise EvlogError(f"{path}: bad evlog header")
+        out: List[Record] = []
+        off = len(HEADER)
+        n = len(data)
+        while off + REC_HEAD_SIZE <= n:
+            plen, crc, t, h, flags, r = _REC_HEAD.unpack_from(data, off)
+            start = off + REC_HEAD_SIZE
+            if start + plen > n:
+                break   # truncated tail write: stop cleanly
+            if (t_lo <= t < t_hi and (ehash == 0 or h == ehash)
+                    and (rid is None or r == rid)):
+                body = data[off + 8:start + plen]
+                if zlib.crc32(body) != crc:
+                    raise EvlogError(f"{path}: CRC mismatch at offset {off}")
+                out.append((t, h, flags, r, data[start:start + plen]))
+            off = start + plen
+        return out
+
+    def verify(self, path: str) -> int:
+        count = 0
+        with open(path, "rb") as f:
+            data = f.read()
+        if len(data) < len(HEADER) or data[:8] != MAGIC:
+            raise EvlogError(f"{path}: bad evlog header")
+        off = len(HEADER)
+        n = len(data)
+        while off + REC_HEAD_SIZE <= n:
+            plen, crc, *_ = _REC_HEAD.unpack_from(data, off)
+            start = off + REC_HEAD_SIZE
+            if start + plen > n:
+                raise EvlogError(f"{path}: truncated record at {off}")
+            if zlib.crc32(data[off + 8:start + plen]) != crc:
+                raise EvlogError(f"{path}: CRC mismatch at offset {off}")
+            count += 1
+            off = start + plen
+        return count
+
+
+class EvlogCodec(_CodecBase):
+    """ctypes bindings over libpioevlog.so."""
+
+    name = "native"
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.evlog_create.restype = ctypes.c_int64
+        lib.evlog_create.argtypes = [ctypes.c_char_p]
+        lib.evlog_append.restype = ctypes.c_int64
+        lib.evlog_append.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_char_p, ctypes.c_uint32]
+        lib.evlog_scan.restype = ctypes.c_int64
+        lib.evlog_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.evlog_verify.restype = ctypes.c_int64
+        lib.evlog_verify.argtypes = [ctypes.c_char_p]
+        lib.evlog_free.restype = None
+        lib.evlog_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.evlog_entity_hash.restype = ctypes.c_uint64
+        lib.evlog_entity_hash.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+
+    def create(self, path: str) -> None:
+        rc = self._lib.evlog_create(path.encode())
+        if rc < 0:
+            raise EvlogError(f"evlog_create({path}) failed: errno {-rc}")
+
+    def append(self, path: str, records: List[Record]) -> None:
+        n = len(records)
+        payloads = b"".join(r[4] for r in records)
+        lens = (ctypes.c_uint32 * n)(*[len(r[4]) for r in records])
+        times = (ctypes.c_int64 * n)(*[r[0] for r in records])
+        hashes = (ctypes.c_uint64 * n)(*[r[1] for r in records])
+        flags = (ctypes.c_uint8 * n)(*[r[2] for r in records])
+        ids = b"".join(r[3] for r in records)
+        rc = self._lib.evlog_append(path.encode(), payloads, lens, times,
+                                    hashes, flags, ids, n)
+        if rc < 0:
+            raise EvlogError(f"evlog_append({path}) failed: errno {-rc}")
+
+    def scan(self, path: str, t_lo: int = T_MIN, t_hi: int = T_MAX,
+             ehash: int = 0, rid: Optional[bytes] = None) -> List[Record]:
+        out_buf = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.evlog_scan(path.encode(), t_lo, t_hi, ehash, rid,
+                                  ctypes.byref(out_buf),
+                                  ctypes.byref(out_len))
+        if rc < 0:
+            raise EvlogError(f"evlog_scan({path}) failed: errno {-rc}")
+        try:
+            data = ctypes.string_at(out_buf, out_len.value) if rc else b""
+        finally:
+            if out_buf:
+                self._lib.evlog_free(out_buf)
+        return self.unpack_records(data)
+
+    def verify(self, path: str) -> int:
+        rc = self._lib.evlog_verify(path.encode())
+        if rc < 0:
+            raise EvlogError(f"evlog_verify({path}) failed: errno {-rc}")
+        return int(rc)
+
+
+_lock = threading.Lock()
+_codec = None
+
+
+def _so_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "_libpioevlog.so")
+
+
+def _source_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native", "evlog.cc")
+
+
+def _build_native() -> Optional[str]:
+    """Compile native/evlog.cc next to this module; None if unavailable."""
+    so = _so_path()
+    src = _source_path()
+    if os.path.exists(so) and os.path.exists(src) and \
+            os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    if not os.path.exists(src):
+        return so if os.path.exists(so) else None
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", so, src],
+            check=True, capture_output=True, timeout=120)
+        return so
+    except (OSError, subprocess.SubprocessError):
+        return so if os.path.exists(so) else None
+
+
+def get_codec(force: Optional[str] = None):
+    """The process-wide codec: native when buildable, else pure Python.
+
+    ``force`` (or env ``PIO_EVLOG_CODEC``) = ``native`` | ``python``.
+    """
+    global _codec
+    mode = force or os.environ.get("PIO_EVLOG_CODEC", "auto")
+    if mode == "python":
+        return PyCodec()
+    with _lock:
+        if _codec is not None and force is None:
+            return _codec
+        so = _build_native()
+        if so is not None:
+            try:
+                codec = EvlogCodec(ctypes.CDLL(so))
+            except OSError:
+                codec = None
+        else:
+            codec = None
+        if codec is None:
+            if mode == "native":
+                raise EvlogError("native evlog codec unavailable "
+                                 "(g++ missing and no prebuilt .so)")
+            codec = PyCodec()
+        if force is None:
+            _codec = codec
+        return codec

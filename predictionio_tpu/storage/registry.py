@@ -157,6 +157,11 @@ class Storage:
                     ParquetEventsClient)
                 client = ParquetEventsClient(
                     conf.get("PATH", os.path.join(_DEFAULT_HOME, "events")))
+            elif stype == "evlog":
+                from predictionio_tpu.storage.evlog_backend import EvlogClient
+                client = EvlogClient(
+                    conf.get("PATH", os.path.join(_DEFAULT_HOME, "evlog")),
+                    codec=conf.get("CODEC"))
             elif stype in ("localfs", "fs"):
                 client = conf  # path-configured; no connection to manage
             else:
@@ -257,6 +262,11 @@ def _construct(stype: str, kind: str, client, source_conf: Dict[str, str]):
             raise StorageError("parquet source only supports EVENTDATA")
         from predictionio_tpu.storage.parquet_events import ParquetEvents
         return ParquetEvents(client)
+    if stype == "evlog":
+        if kind != "events":
+            raise StorageError("evlog source only supports EVENTDATA")
+        from predictionio_tpu.storage.evlog_backend import EvlogEvents
+        return EvlogEvents(client)
     if stype == "localfs":
         if kind != "models":
             raise StorageError("localfs source only supports MODELDATA")

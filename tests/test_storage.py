@@ -27,14 +27,23 @@ def t(days):
     return T0 + dt.timedelta(days=days)
 
 
-@pytest.fixture(params=["sqlite", "parquet"])
+@pytest.fixture(params=["sqlite", "parquet", "evlog-native", "evlog-python"])
 def store(tmp_path, request):
     """One shared behavioral contract, run against every event backend
     (the reference's LEventsSpec/PEventsSpec pattern)."""
     if request.param == "sqlite":
         s = SqliteEvents(SqliteClient(str(tmp_path / "events.db")))
-    else:
+    elif request.param == "parquet":
         s = ParquetEvents(ParquetEventsClient(str(tmp_path / "events_pq")))
+    else:
+        from predictionio_tpu.storage.evlog_backend import (
+            EvlogClient, EvlogEvents)
+        codec = request.param.split("-")[1]
+        if codec == "native":
+            from predictionio_tpu.native.evlog import get_codec, EvlogCodec
+            if not isinstance(get_codec(), EvlogCodec):
+                pytest.skip("native evlog codec unavailable (no g++)")
+        s = EvlogEvents(EvlogClient(str(tmp_path / "evlog"), codec=codec))
     s.init_channel(1)
     yield s
     s.close()

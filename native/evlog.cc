@@ -182,12 +182,22 @@ int64_t evlog_append(const char* path, const uint8_t* payloads,
   }
   int fd = ::open(path, O_WRONLY | O_APPEND);
   if (fd < 0) { free(buf); return -errno; }
+  // remember the pre-append size so a torn write (ENOSPC, kill) can be
+  // truncated away — a half-frame left on disk would desync the framing of
+  // every record appended after it
+  struct stat st;
   int64_t rc = 0;
+  if (fstat(fd, &st) != 0) rc = -errno;
   uint64_t off = 0;
-  while (off < total) {
+  while (rc == 0 && off < total) {
     ssize_t w = write(fd, buf + off, total - off);
     if (w < 0) { rc = -errno; break; }
     off += static_cast<uint64_t>(w);
+  }
+  if (rc != 0 && off > 0) {
+    if (ftruncate(fd, st.st_size) != 0) {
+      // truncation failed too; surface the original error regardless
+    }
   }
   ::close(fd);
   free(buf);

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
 
 
@@ -47,11 +48,20 @@ def params_to_json(params: Any) -> Any:
     raise TypeError(f"cannot serialize params of type {type(params).__name__}")
 
 
+def _snake(name: str) -> str:
+    """camelCase -> snake_case (appName -> app_name)."""
+    return re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", name).lower()
+
+
 def params_from_json(data: Any, params_class: Optional[type] = None) -> Any:
     """JSON value -> params_class instance (or plain dict when no class).
 
-    Unknown keys raise (the reference's json4s extract is strict in the same
-    way for missing fields; strictness here catches typo'd hyperparameters).
+    Reference engine.json variants use camelCase keys (appName,
+    numIterations — Engine.scala:355 extracts into Scala case classes);
+    those are accepted and mapped onto the snake_case dataclass fields, as
+    are per-class `json_aliases` (e.g. ALS's "lambda" -> reg). Unknown keys
+    raise (the reference's json4s extract is strict in the same way for
+    missing fields; strictness here catches typo'd hyperparameters).
     """
     if data is None:
         data = {}
@@ -60,12 +70,28 @@ def params_from_json(data: Any, params_class: Optional[type] = None) -> Any:
     if not dataclasses.is_dataclass(params_class):
         return params_class(**data)
     field_names = {f.name for f in dataclasses.fields(params_class)}
-    unknown = set(data) - field_names
+    aliases = getattr(params_class, "json_aliases", {})
+    mapped = {}
+    sources = {}
+    unknown = []
+    for key, value in dict(data).items():
+        name = aliases.get(key, key)
+        if name not in field_names:
+            name = _snake(name)
+        if name in field_names:
+            if name in mapped:
+                raise ValueError(
+                    f"parameters {sources[name]!r} and {key!r} both set "
+                    f"field {name!r} of {params_class.__name__}")
+            mapped[name] = value
+            sources[name] = key
+        else:
+            unknown.append(key)
     if unknown:
         raise ValueError(
             f"unknown parameter(s) {sorted(unknown)} for "
             f"{params_class.__name__}; expected among {sorted(field_names)}")
-    return params_class(**data)
+    return params_class(**mapped)
 
 
 @dataclasses.dataclass

@@ -96,6 +96,8 @@ class ECommercePreparator(Preparator):
 class ECommAlgorithmParams(Params):
     """ECommAlgorithmParams parity (ECommAlgorithm.scala:46-57)."""
 
+    json_aliases = {"lambda": "reg"}
+
     app_name: str
     unseen_only: bool = False
     seen_events: Tuple[str, ...] = ("buy", "view")

@@ -143,6 +143,8 @@ class RecommendationPreparator(Preparator):
 class AlgorithmParams(Params):
     """ALSAlgorithm.scala params: rank, numIterations, lambda, seed."""
 
+    json_aliases = {"lambda": "reg"}
+
     rank: int = 10
     num_iterations: int = 10
     reg: float = 0.01
@@ -190,7 +192,14 @@ class ALSAlgorithm(Algorithm):
             item_scores=[ItemScore(item=i, score=s) for i, s in recs])
 
     def batch_predict(self, model: ALSModel, queries):
-        return [(i, self.predict(model, q)) for i, q in queries]
+        """Vectorized: one device matmul for the whole batch — the eval /
+        micro-batch fast path (vs CreateServer.scala:508 serial loop)."""
+        reqs = [(q.user, q.num, (), None) for _, q in queries]
+        recs = model.recommend_batch(reqs)
+        return [
+            (i, PredictedResult(item_scores=[
+                ItemScore(item=it, score=s) for it, s in r]))
+            for (i, _), r in zip(queries, recs)]
 
 
 class RecommendationServing(FirstServing):

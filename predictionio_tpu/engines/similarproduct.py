@@ -124,6 +124,8 @@ class SimilarProductPreparator(Preparator):
 
 @dataclasses.dataclass
 class ALSAlgorithmParams(Params):
+    json_aliases = {"lambda": "reg"}
+
     rank: int = 10
     num_iterations: int = 20
     reg: float = 0.01

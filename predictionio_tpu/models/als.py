@@ -339,10 +339,18 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams
 # Model
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("num",))
+@functools.partial(jax.jit, static_argnames="num")
 def _topk_scores(user_vec: jax.Array, V: jax.Array, mask: jax.Array,
                  num: int) -> Tuple[jax.Array, jax.Array]:
     scores = V @ user_vec                       # [n_items] MXU matvec
+    scores = jnp.where(mask, -jnp.inf, scores)
+    return jax.lax.top_k(scores, num)
+
+
+@functools.partial(jax.jit, static_argnames="num")
+def _topk_scores_batch(user_vecs: jax.Array, V: jax.Array, mask: jax.Array,
+                       num: int) -> Tuple[jax.Array, jax.Array]:
+    scores = user_vecs @ V.T                    # [B, n_items] MXU matmul
     scores = jnp.where(mask, -jnp.inf, scores)
     return jax.lax.top_k(scores, num)
 
@@ -359,6 +367,21 @@ class ALSModel:
     U: np.ndarray            # [n_users, K]
     V: np.ndarray            # [n_items, K]
 
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_resident", None)      # device arrays never hit the checkpoint
+        return d
+
+    @property
+    def V_device(self) -> jax.Array:
+        """Item factors resident on device across requests (SURVEY §2.9 P7:
+        serve-time model residency). Re-uploaded only when V is swapped."""
+        cached = getattr(self, "_resident", None)
+        if cached is None or cached[0] is not self.V:
+            cached = (self.V, jax.device_put(np.asarray(self.V)))
+            self._resident = cached
+        return cached[1]
+
     def user_index(self, user_id: str) -> Optional[int]:
         return vocab_index(self.user_vocab, user_id)
 
@@ -371,13 +394,8 @@ class ALSModel:
             return None
         return float(self.U[ui] @ self.V[ii])
 
-    def recommend(self, user_id: str, num: int,
-                  exclude_items: Tuple[str, ...] = (),
-                  allow_items: Optional[Tuple[str, ...]] = None):
-        """Top-num (item_id, score), optionally excluding/allowlisting."""
-        ui = self.user_index(user_id)
-        if ui is None:
-            return []
+    def _query_mask(self, exclude_items: Tuple[str, ...],
+                    allow_items) -> np.ndarray:
         mask = np.zeros(len(self.item_vocab), dtype=bool)
         for it in exclude_items:
             ii = self.item_index(it)
@@ -390,15 +408,66 @@ class ALSModel:
                 if ii is not None:
                     allow[ii] = False
             mask |= allow
+        return mask
+
+    def recommend(self, user_id: str, num: int,
+                  exclude_items: Tuple[str, ...] = (),
+                  allow_items: Optional[Tuple[str, ...]] = None):
+        """Top-num (item_id, score), optionally excluding/allowlisting."""
+        if num < 0:
+            raise ValueError(f"num must be >= 0, got {num}")
+        ui = self.user_index(user_id)
+        if ui is None:
+            return []
+        mask = self._query_mask(exclude_items, allow_items)
         k = min(num, len(self.item_vocab))
         scores, idx = _topk_scores(
-            jnp.asarray(self.U[ui]), jnp.asarray(self.V), jnp.asarray(mask), k)
+            jnp.asarray(self.U[ui]), self.V_device, jnp.asarray(mask), k)
         scores = np.asarray(scores)
         idx = np.asarray(idx)
         out = []
         for s, i in zip(scores, idx):
             if np.isfinite(s):
                 out.append((str(self.item_vocab[i]), float(s)))
+        return out
+
+    def recommend_batch(self, requests):
+        """Batched recommend: one [B,K]@[K,N] matmul + top_k for B queries.
+
+        requests: sequence of (user_id, num, exclude_items, allow_items).
+        Returns a list parallel to requests; [] for unknown users. This is
+        the device batch behind query-server micro-batching (SURVEY §2.9 P7)
+        — the reference serves queries one at a time in a serial loop
+        (CreateServer.scala:508).
+        """
+        n_items = len(self.item_vocab)
+        for _u, num, _ex, _allow in requests:
+            if num < 0:
+                raise ValueError(f"num must be >= 0, got {num}")
+        rows, uidx = [], []
+        for j, (user_id, _num, _ex, _allow) in enumerate(requests):
+            ui = self.user_index(user_id)
+            if ui is not None:
+                rows.append(j)
+                uidx.append(ui)
+        out = [[] for _ in requests]
+        if not rows:
+            return out
+        mask = np.stack([
+            self._query_mask(requests[j][2], requests[j][3]) for j in rows])
+        k = min(max(min(requests[j][1], n_items) for j in rows), n_items)
+        scores, idx = _topk_scores_batch(
+            jnp.asarray(self.U[np.asarray(uidx)]), self.V_device,
+            jnp.asarray(mask), k)
+        scores = np.asarray(scores)
+        idx = np.asarray(idx)
+        for b, j in enumerate(rows):
+            want = min(requests[j][1], n_items)
+            recs = []
+            for s, i in zip(scores[b][:want], idx[b][:want]):
+                if np.isfinite(s):
+                    recs.append((str(self.item_vocab[i]), float(s)))
+            out[j] = recs
         return out
 
 

@@ -94,13 +94,24 @@ class PyCodec(_CodecBase):
         buf = b"".join(
             self.pack_record(t, h, flags, rid, payload)
             for (t, h, flags, rid, payload) in records)
-        try:
-            # r+b (not ab): appending must never create a header-less file
-            with open(path, "r+b") as f:
-                f.seek(0, os.SEEK_END)
+        # existence check first (append must never create a header-less
+        # file), then O_APPEND for kernel-level append atomicity so
+        # concurrent writer processes can't interleave within a record
+        if not os.path.exists(path):
+            raise EvlogError(f"{path}: no such evlog")
+        with open(path, "ab") as f:
+            start = f.tell()
+            try:
                 f.write(buf)
-        except FileNotFoundError as ex:
-            raise EvlogError(f"{path}: no such evlog") from ex
+                f.flush()
+            except OSError:
+                # torn write (e.g. ENOSPC): truncate the half-frame away so
+                # later appends don't land after it and desync the framing
+                try:
+                    f.truncate(start)
+                except OSError:
+                    pass
+                raise
 
     def scan(self, path: str, t_lo: int = T_MIN, t_hi: int = T_MAX,
              ehash: int = 0, rid: Optional[bytes] = None) -> List[Record]:

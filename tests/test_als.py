@@ -158,3 +158,41 @@ def test_als_model_pickles():
         U=np.ones((1, 2), np.float32), V=np.ones((2, 2), np.float32))
     out = pickle.loads(pickle.dumps(model))
     assert out.predict_rating("a", "x") == pytest.approx(2.0)
+
+
+def test_als_recommend_batch_matches_single():
+    users, items, ratings, nu, ni = synthetic_ratings(seed=5)
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    U, V = train_als(single_mesh(), data,
+                     ALSParams(rank=8, num_iterations=4, chunk_size=64))
+    user_vocab = np.array([f"u{i:03d}" for i in range(nu)], dtype=object)
+    item_vocab = np.array([f"i{i:03d}" for i in range(ni)], dtype=object)
+    model = ALSModel(user_vocab=user_vocab, item_vocab=item_vocab, U=U, V=V)
+
+    single = [model.recommend("u000", 5),
+              model.recommend("u001", 3, exclude_items=("i002",)),
+              [],  # unknown user
+              model.recommend("u002", 7)]
+    batched = model.recommend_batch([
+        ("u000", 5, (), None),
+        ("u001", 3, ("i002",), None),
+        ("ghost", 4, (), None),
+        ("u002", 7, (), None)])
+    assert len(batched) == 4
+    for got, want in zip(batched, single):
+        assert [i for i, _ in got] == [i for i, _ in want]
+        for (_, gs), (_, ws) in zip(got, want):
+            assert gs == pytest.approx(ws, abs=1e-5)
+
+
+def test_als_model_pickle_drops_device_cache():
+    import pickle
+
+    model = ALSModel(
+        user_vocab=np.array(["a"], dtype=object),
+        item_vocab=np.array(["x", "y"], dtype=object),
+        U=np.ones((1, 2), np.float32), V=np.ones((2, 2), np.float32))
+    _ = model.V_device  # populate residency cache
+    out = pickle.loads(pickle.dumps(model))
+    assert not hasattr(out, "_resident")
+    assert out.recommend("a", 1)

@@ -163,3 +163,26 @@ def test_prepare_deploy_retrains_none(ctx):
     assert persisted == [None]
     deployed = engine.prepare_deploy(ctx, ep, "inst-2", persisted)
     assert deployed.models[0] == result.models[0]  # retrained to same model
+
+
+def test_params_from_json_accepts_camel_case_and_aliases():
+    """Reference engine.json variants are camelCase (Engine.scala:355);
+    they must be drop-in: appName -> app_name, lambda -> reg."""
+    from predictionio_tpu.core.params import params_from_json
+    from predictionio_tpu.engines.recommendation import (
+        AlgorithmParams, DataSourceParams,
+    )
+
+    ds = params_from_json({"appName": "myapp"}, DataSourceParams)
+    assert ds.app_name == "myapp"
+    algo = params_from_json(
+        {"rank": 12, "numIterations": 7, "lambda": 0.05,
+         "implicitPrefs": True}, AlgorithmParams)
+    assert algo.num_iterations == 7
+    assert algo.reg == 0.05
+    assert algo.implicit_prefs is True
+    # snake_case still accepted; unknown keys still strict
+    assert params_from_json({"app_name": "x"}, DataSourceParams).app_name == "x"
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="unknown parameter"):
+        params_from_json({"rnk": 5}, AlgorithmParams)

@@ -177,3 +177,41 @@ def test_batch_predict(app_with_ratings, tmp_path):
     assert lines[0]["query"] == {"user": "u1", "num": 3}
     assert len(lines[0]["prediction"]["itemScores"]) == 3
     assert len(lines[1]["prediction"]["itemScores"]) == 2
+
+
+async def test_concurrent_queries_micro_batched(app_with_ratings):
+    """Concurrent requests drain into one device batch (SURVEY §2.9 P7)."""
+    import asyncio
+
+    engine, instance = train_instance(app_with_ratings)
+    result, ctx = load_for_deploy(engine, instance)
+    server = create_query_server(engine, result, instance, ctx)
+    server.batcher.linger_s = 0.01  # force coalescing in the test
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        async def one(user, num):
+            resp = await c.post("/queries.json",
+                                json={"user": user, "num": num})
+            return resp.status, await resp.json()
+
+        out = await asyncio.gather(
+            *[one(f"u{i % 6}", 3) for i in range(16)],
+            one("ghost", 3),
+            one("u1", 5))
+        for status, body in out[:16]:
+            assert status == 200
+            assert len(body["itemScores"]) == 3
+        assert out[16][1]["itemScores"] == []       # unknown user isolated
+        assert len(out[17][1]["itemScores"]) == 5   # per-query num honored
+        # batched result matches the serial path (scores differ only by
+        # matmul-vs-matvec accumulation order)
+        serial = await c.post("/queries.json", json={"user": "u1", "num": 5})
+        serial_scores = (await serial.json())["itemScores"]
+        batch_scores = out[17][1]["itemScores"]
+        assert [s["item"] for s in serial_scores] == \
+               [s["item"] for s in batch_scores]
+        for a, b in zip(serial_scores, batch_scores):
+            assert a["score"] == pytest.approx(b["score"], abs=1e-4)
+    finally:
+        await c.close()

@@ -182,21 +182,23 @@ int64_t evlog_append(const char* path, const uint8_t* payloads,
   }
   int fd = ::open(path, O_WRONLY | O_APPEND);
   if (fd < 0) { free(buf); return -errno; }
-  // remember the pre-append size so a torn write (ENOSPC, kill) can be
-  // truncated away — a half-frame left on disk would desync the framing of
-  // every record appended after it
-  struct stat st;
   int64_t rc = 0;
-  if (fstat(fd, &st) != 0) rc = -errno;
   uint64_t off = 0;
-  while (rc == 0 && off < total) {
+  while (off < total) {
     ssize_t w = write(fd, buf + off, total - off);
     if (w < 0) { rc = -errno; break; }
     off += static_cast<uint64_t>(w);
   }
   if (rc != 0 && off > 0) {
-    if (ftruncate(fd, st.st_size) != 0) {
-      // truncation failed too; surface the original error regardless
+    // torn write (ENOSPC, signal): drop the half-frame so later appends
+    // don't land after it and desync the framing — but only while our bytes
+    // are still the file tail; truncating a stale offset would destroy
+    // records a concurrent writer committed after ours
+    off_t end = lseek(fd, 0, SEEK_CUR);
+    struct stat st;
+    if (end >= 0 && fstat(fd, &st) == 0 &&
+        st.st_size == end && static_cast<uint64_t>(end) >= off) {
+      (void)!ftruncate(fd, end - static_cast<off_t>(off));
     }
   }
   ::close(fd);

@@ -456,11 +456,22 @@ class ALSModel:
         mask = np.stack([
             self._query_mask(requests[j][2], requests[j][3]) for j in rows])
         k = min(max(min(requests[j][1], n_items) for j in rows), n_items)
+        # bucket B and k to powers of two so the serving path compiles a
+        # handful of shapes instead of one per (batch, num) combination —
+        # an un-bucketed jit would stall whole batches on XLA recompiles
+        b_pad = 1 << (len(rows) - 1).bit_length()
+        k_pad = min(1 << max(k - 1, 0).bit_length(), n_items)
+        u_batch = self.U[np.asarray(uidx)]
+        if b_pad > len(rows):
+            u_batch = np.concatenate(
+                [u_batch, np.zeros((b_pad - len(rows), u_batch.shape[1]),
+                                   u_batch.dtype)])
+            mask = np.concatenate(
+                [mask, np.ones((b_pad - len(rows), n_items), bool)])
         scores, idx = _topk_scores_batch(
-            jnp.asarray(self.U[np.asarray(uidx)]), self.V_device,
-            jnp.asarray(mask), k)
-        scores = np.asarray(scores)
-        idx = np.asarray(idx)
+            jnp.asarray(u_batch), self.V_device, jnp.asarray(mask), k_pad)
+        scores = np.asarray(scores)[:len(rows), :k]
+        idx = np.asarray(idx)[:len(rows), :k]
         for b, j in enumerate(rows):
             want = min(requests[j][1], n_items)
             recs = []

@@ -94,24 +94,31 @@ class PyCodec(_CodecBase):
         buf = b"".join(
             self.pack_record(t, h, flags, rid, payload)
             for (t, h, flags, rid, payload) in records)
-        # existence check first (append must never create a header-less
-        # file), then O_APPEND for kernel-level append atomicity so
-        # concurrent writer processes can't interleave within a record
-        if not os.path.exists(path):
-            raise EvlogError(f"{path}: no such evlog")
-        with open(path, "ab") as f:
-            start = f.tell()
+        # O_APPEND (atomic wrt concurrent writers) WITHOUT O_CREAT: append
+        # must never create a header-less file, and open-without-create
+        # closes the exists()/open race
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_APPEND)
+        except FileNotFoundError as ex:
+            raise EvlogError(f"{path}: no such evlog") from ex
+        written = 0
+        try:
+            while written < len(buf):
+                written += os.write(fd, buf[written:])
+        except OSError:
+            # torn write (e.g. ENOSPC): drop the half-frame so later appends
+            # don't land after it and desync the framing — but only when our
+            # bytes are still the file tail; truncating a stale offset would
+            # destroy records a concurrent writer committed after ours
             try:
-                f.write(buf)
-                f.flush()
+                end = os.lseek(fd, 0, os.SEEK_CUR)
+                if written and os.fstat(fd).st_size == end:
+                    os.ftruncate(fd, end - written)
             except OSError:
-                # torn write (e.g. ENOSPC): truncate the half-frame away so
-                # later appends don't land after it and desync the framing
-                try:
-                    f.truncate(start)
-                except OSError:
-                    pass
-                raise
+                pass
+            raise
+        finally:
+            os.close(fd)
 
     def scan(self, path: str, t_lo: int = T_MIN, t_hi: int = T_MAX,
              ehash: int = 0, rid: Optional[bytes] = None) -> List[Record]:

@@ -1,0 +1,208 @@
+"""Long-context attention with sequence parallelism over a device mesh.
+
+The reference has no attention models (SURVEY.md §5: "long-context /
+sequence parallelism — absent"), but this framework treats long-context and
+distributed execution as first-class: engines that embed sequence models
+(session-based recommendation, event-stream encoders) need attention that
+scales past a single chip's HBM. Three strategies, one contract:
+
+* ``mha`` — dense reference implementation (single device, or fully
+  replicated); the numerical ground truth the parallel paths are tested
+  against.
+* ``ring_attention`` — sequence parallelism: Q/K/V sharded along the
+  sequence axis of a ``Mesh``; K/V blocks rotate around the ring via
+  ``lax.ppermute`` while each device accumulates its queries' output with
+  the flash-attention running-max/denominator recurrence. HBM per device is
+  O(L/p); comms ride ICI neighbor-to-neighbor, overlapping with the block
+  matmuls (the Ring Attention construction, cf. PAPERS.md).
+* ``ulysses_attention`` — all-to-all sequence↔head resharding: each device
+  gathers the FULL sequence for H/p heads (two ``all_to_all``s), runs dense
+  attention locally, and reshards back. Cheaper comms volume than ring for
+  moderate L; requires heads % devices == 0.
+
+All paths use the same [batch, seq, heads, head_dim] layout, jit/shard_map
+compile to static shapes, and keep the softmax in float32 regardless of
+input dtype (bfloat16 QKV with f32 accumulation is the TPU-native recipe:
+matmuls hit the MXU in bf16, the recurrence stays stable in f32).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30    # large-negative instead of -inf: avoids NaN in exp(m - m)
+
+
+def _causal_mask(scores: jax.Array, q_off, k_off) -> jax.Array:
+    """Mask scores [..., Lq, Lk] so query i attends to keys j with
+    global_j <= global_i, where globals are local indices + offsets."""
+    lq, lk = scores.shape[-2], scores.shape[-1]
+    qi = q_off + jnp.arange(lq)[:, None]
+    kj = k_off + jnp.arange(lk)[None, :]
+    return jnp.where(kj <= qi, scores, NEG_INF)
+
+
+def mha(q: jax.Array, k: jax.Array, v: jax.Array,
+        causal: bool = False) -> jax.Array:
+    """Dense multi-head attention. q,k,v: [B, L, H, D] -> [B, L, H, D]."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, 0, 0)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        block_k: int = 512, causal: bool = False) -> jax.Array:
+    """Flash-style single-device attention: stream over K/V blocks with the
+    running-max/denominator recurrence so the [Lq, Lk] score matrix never
+    materializes. O(L * block_k) memory; exact (not approximate)."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if lk % block_k:
+        raise ValueError(f"seq len {lk} not divisible by block_k {block_k}")
+    n_blocks = lk // block_k
+    scale = d ** -0.5
+    kb = k.reshape(b, n_blocks, block_k, h, d)
+    vb = v.reshape(b, n_blocks, block_k, h, d)
+
+    def step(carry, xs):
+        o, m, l = carry
+        j, k_j, v_j = xs
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_j,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, 0, j * block_k)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_j.astype(jnp.float32))
+        return (o, m_new, l), None
+
+    o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    (o, _, l), _ = jax.lax.scan(
+        step, (o0, m0, l0),
+        (jnp.arange(n_blocks), jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0)))
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
+    """shard_map body: q/k/v are the LOCAL sequence shards [B, L/p, H, D]."""
+    p_size = jax.lax.psum(1, axis)
+    r = jax.lax.axis_index(axis)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = d ** -0.5
+    q_off = r * lq
+
+    def step(carry, t):
+        o, m, l, k_t, v_t = carry
+        # device r holds the kv block originally on device (r + t) mod p
+        k_off = ((r + t) % p_size) * lk
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_t,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, q_off, k_off)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        pr = jnp.exp(s - m_new[..., None])
+        l = l * alpha + pr.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", pr, v_t.astype(jnp.float32))
+        # rotate: receive the next block from the right neighbor
+        perm = [(i, (i - 1) % p_size) for i in range(p_size)]
+        k_t = jax.lax.ppermute(k_t, axis, perm)
+        v_t = jax.lax.ppermute(v_t, axis, perm)
+        return (o, m_new, l, k_t, v_t), None
+
+    # zero-init carries must be marked device-varying over the ring axis or
+    # scan rejects the carry type under shard_map
+    def _vary(x):
+        return jax.lax.pcast(x, (axis,), to="varying")
+
+    o0 = _vary(jnp.zeros((b, h, lq, d), jnp.float32))
+    m0 = _vary(jnp.full((b, h, lq), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, lq), jnp.float32))
+    (o, _, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(p_size))
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis: str = "seq", causal: bool = False) -> jax.Array:
+    """Sequence-parallel exact attention over ``mesh[axis]``.
+
+    Inputs [B, L, H, D] are (re)sharded along L; each of the p devices keeps
+    its L/p query rows and streams all p K/V blocks through the flash
+    recurrence, passing blocks around the ring with ``ppermute`` — peak HBM
+    is O(L/p * D) per device, enabling sequences p× longer than one chip
+    holds. Returns output sharded the same way.
+    """
+    if q.shape[1] % mesh.shape[axis]:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by mesh axis "
+            f"'{axis}' size {mesh.shape[axis]}")
+    fn = _sharded_fn(_ring_attention_local, mesh, axis, causal)
+    sharding = NamedSharding(mesh, P(None, axis, None, None))
+    return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
+              jax.device_put(v, sharding))
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_fn(local_fn, mesh: Mesh, axis: str, causal: bool):
+    """Cache the jitted shard_map wrapper per (mesh, axis, causal) so
+    repeated calls reuse the compiled executable instead of re-tracing."""
+    spec = P(None, axis, None, None)
+    return jax.jit(jax.shard_map(
+        functools.partial(local_fn, axis=axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+
+
+def _ulysses_local(q, k, v, *, axis: str, causal: bool):
+    """shard_map body: reshard seq-sharded -> head-sharded, dense attention
+    on the full sequence for the local head group, reshard back."""
+    # [B, L/p, H, D] --all_to_all--> [B, L, H/p, D]
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    out = mha(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+              causal=causal)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      axis: str = "seq", causal: bool = False) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses construction):
+    two ``all_to_all``s swap the sharded dimension seq↔heads so each device
+    runs dense attention over the FULL sequence for H/p heads. Requires
+    heads divisible by the axis size. Same sharded [B, L, H, D] contract as
+    ``ring_attention``."""
+    p_size = mesh.shape[axis]
+    if q.shape[2] % p_size:
+        raise ValueError(
+            f"heads {q.shape[2]} not divisible by mesh axis size {p_size}")
+    if q.shape[1] % p_size:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by mesh axis size {p_size}")
+    fn = _sharded_fn(_ulysses_local, mesh, axis, causal)
+    sharding = NamedSharding(mesh, P(None, axis, None, None))
+    return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
+              jax.device_put(v, sharding))

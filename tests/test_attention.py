@@ -1,0 +1,75 @@
+"""Long-context attention: ring / Ulysses sequence parallelism vs the dense
+reference, on the 8-device virtual CPU mesh (conftest.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.attention import (
+    blockwise_attention, mha, ring_attention, ulysses_attention,
+)
+
+
+def qkv(seed=0, b=2, l=64, h=8, d=16, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(b, l, h, d)).astype(np.float32), dtype)
+    return mk(), mk(), mk()
+
+
+def test_blockwise_matches_dense():
+    q, k, v = qkv()
+    dense = mha(q, k, v)
+    block = blockwise_attention(q, k, v, block_k=16)
+    np.testing.assert_allclose(np.asarray(block), np.asarray(dense),
+                               atol=1e-5)
+
+
+def test_blockwise_causal_matches_dense():
+    q, k, v = qkv(seed=1)
+    dense = mha(q, k, v, causal=True)
+    block = blockwise_attention(q, k, v, block_k=16, causal=True)
+    np.testing.assert_allclose(np.asarray(block), np.asarray(dense),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(mesh8, causal):
+    q, k, v = qkv(seed=2)
+    dense = mha(q, k, v, causal=causal)
+    ring = ring_attention(q, k, v, mesh8, axis="data", causal=causal)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(mesh8, causal):
+    q, k, v = qkv(seed=3)
+    dense = mha(q, k, v, causal=causal)
+    uly = ulysses_attention(q, k, v, mesh8, axis="data", causal=causal)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense),
+                               atol=1e-5)
+
+
+def test_ring_attention_bf16_inputs(mesh8):
+    q, k, v = qkv(seed=4, dtype=jnp.bfloat16)
+    dense = mha(q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32))
+    ring = ring_attention(q, k, v, mesh8, axis="data")
+    assert ring.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(ring, dtype=np.float32), np.asarray(dense), atol=0.05)
+
+
+def test_ring_rejects_indivisible_seq(mesh8):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, 12, 4, 8)).astype(np.float32))
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(x, x, x, mesh8, axis="data")
+
+
+def test_ulysses_rejects_indivisible_heads(mesh8):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, 64, 4, 8)).astype(np.float32))
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(x, x, x, mesh8, axis="data")

@@ -26,6 +26,7 @@
 #include <cstring>
 
 #include <fcntl.h>
+#include <sys/file.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -182,26 +183,26 @@ int64_t evlog_append(const char* path, const uint8_t* payloads,
   }
   int fd = ::open(path, O_WRONLY | O_APPEND);
   if (fd < 0) { free(buf); return -errno; }
+  // flock serializes writer processes: O_APPEND already keeps whole writes
+  // from interleaving, the lock additionally makes the torn-write cleanup
+  // below safe (no concurrent record can land mid-error-handling)
   int64_t rc = 0;
+  if (::flock(fd, LOCK_EX) != 0) rc = -errno;
   uint64_t off = 0;
-  while (off < total) {
+  while (rc == 0 && off < total) {
     ssize_t w = write(fd, buf + off, total - off);
     if (w < 0) { rc = -errno; break; }
     off += static_cast<uint64_t>(w);
   }
   if (rc != 0 && off > 0) {
     // torn write (ENOSPC, signal): drop the half-frame so later appends
-    // don't land after it and desync the framing — but only while our bytes
-    // are still the file tail; truncating a stale offset would destroy
-    // records a concurrent writer committed after ours
+    // don't land after it and desync the framing; safe under flock
     off_t end = lseek(fd, 0, SEEK_CUR);
-    struct stat st;
-    if (end >= 0 && fstat(fd, &st) == 0 &&
-        st.st_size == end && static_cast<uint64_t>(end) >= off) {
+    if (end >= 0 && static_cast<uint64_t>(end) >= off) {
       (void)!ftruncate(fd, end - static_cast<off_t>(off));
     }
   }
-  ::close(fd);
+  ::close(fd);  // releases the flock
   free(buf);
   return rc;
 }

@@ -101,6 +101,21 @@ def train_categorical_nb(points: Sequence[LabeledPoint]
 # Multinomial NB (MLlib analog)
 # ---------------------------------------------------------------------------
 
+def _compact_for_transfer(X: np.ndarray) -> np.ndarray:
+    """Count matrices are usually small non-negative integers stored as
+    float; ship them as uint8/uint16 (4x/2x fewer bytes over the
+    host->device link — the usual bottleneck, SURVEY §7 'HBM bandwidth')
+    and widen to f32 on device."""
+    if X.dtype.kind in "ui":
+        return X
+    if X.dtype.kind != "f" or X.size == 0:
+        return X
+    xmax, xmin = X.max(), X.min()
+    if xmin < 0 or xmax >= 65536 or np.any(np.mod(X, 1)):
+        return X
+    return X.astype(np.uint8 if xmax < 256 else np.uint16)
+
+
 @dataclasses.dataclass
 class MultinomialNBModel:
     """label vocab + log priors [L] + log feature probs [L, F]."""
@@ -116,11 +131,11 @@ class MultinomialNBModel:
 
         @jax.jit
         def score(x, lp, pri):
-            return x @ lp.T + pri[None, :]
+            return x.astype(jnp.float32) @ lp.T + pri[None, :]
 
         return np.asarray(jax.device_get(score(
-            jnp.asarray(X, jnp.float32), jnp.asarray(self.log_prob),
-            jnp.asarray(self.log_prior))))
+            jnp.asarray(_compact_for_transfer(X)),
+            jnp.asarray(self.log_prob), jnp.asarray(self.log_prior))))
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         scores = self.predict_scores(np.atleast_2d(X))
@@ -129,13 +144,31 @@ class MultinomialNBModel:
 
 def train_multinomial_nb(X: np.ndarray, labels: Sequence[str],
                          smoothing: float = 1.0) -> MultinomialNBModel:
-    """MLlib NaiveBayes.train parity (lambda smoothing)."""
+    """MLlib NaiveBayes.train parity (lambda smoothing). Per-label feature
+    counting runs as a one-hot [L,N]@[N,F] device matmul (MXU) when the
+    input is big enough to pay for the transfer."""
     labels = np.asarray(labels, dtype=object)
     label_vocab, label_codes = np.unique(labels, return_inverse=True)
     n_labels = len(label_vocab)
     n_features = X.shape[1]
-    counts = np.zeros((n_labels, n_features), np.float64)
-    np.add.at(counts, label_codes, X)
+    # device path: worth the transfer for big X, but the [N, L] one-hot it
+    # materializes must stay bounded too (many-label inputs would OOM where
+    # the host path needs only the [L, F] buffer)
+    if X.size >= 1_000_000 and X.shape[0] * n_labels * 4 <= 1 << 28:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def count(codes, x):
+            onehot = jax.nn.one_hot(codes, n_labels, dtype=jnp.float32)
+            return onehot.T @ x.astype(jnp.float32)
+
+        counts = np.asarray(jax.device_get(count(
+            jnp.asarray(label_codes),
+            jnp.asarray(_compact_for_transfer(X))))).astype(np.float64)
+    else:
+        counts = np.zeros((n_labels, n_features), np.float64)
+        np.add.at(counts, label_codes, X)
     label_counts = np.bincount(label_codes, minlength=n_labels)
     log_prior = np.log(label_counts / label_counts.sum())
     smoothed = counts + smoothing

@@ -94,31 +94,32 @@ class PyCodec(_CodecBase):
         buf = b"".join(
             self.pack_record(t, h, flags, rid, payload)
             for (t, h, flags, rid, payload) in records)
-        # O_APPEND (atomic wrt concurrent writers) WITHOUT O_CREAT: append
-        # must never create a header-less file, and open-without-create
-        # closes the exists()/open race
+        import fcntl
+
+        # O_APPEND WITHOUT O_CREAT: append must never create a header-less
+        # file, and open-without-create closes the exists()/open race.
+        # flock serializes writer processes so the torn-write cleanup below
+        # can safely truncate: no other record can land mid-error-handling.
         try:
             fd = os.open(path, os.O_WRONLY | os.O_APPEND)
         except FileNotFoundError as ex:
             raise EvlogError(f"{path}: no such evlog") from ex
         written = 0
         try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
             while written < len(buf):
                 written += os.write(fd, buf[written:])
         except OSError:
             # torn write (e.g. ENOSPC): drop the half-frame so later appends
-            # don't land after it and desync the framing — but only when our
-            # bytes are still the file tail; truncating a stale offset would
-            # destroy records a concurrent writer committed after ours
+            # don't land after it and desync the framing; safe under flock
             try:
-                end = os.lseek(fd, 0, os.SEEK_CUR)
-                if written and os.fstat(fd).st_size == end:
-                    os.ftruncate(fd, end - written)
+                if written:
+                    os.ftruncate(fd, os.lseek(fd, 0, os.SEEK_CUR) - written)
             except OSError:
                 pass
             raise
         finally:
-            os.close(fd)
+            os.close(fd)     # releases the flock
 
     def scan(self, path: str, t_lo: int = T_MIN, t_hi: int = T_MAX,
              ehash: int = 0, rid: Optional[bytes] = None) -> List[Record]:

@@ -59,6 +59,31 @@ def mha(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
 
+def _flash_step(q, k_j, v_j, o, m, l, q_off, k_off, causal: bool,
+                scale: float):
+    """One flash-attention accumulation step: fold K/V block (k_j, v_j) at
+    global key offset k_off into the running (o, m, l) state for queries q
+    at global offset q_off. Shared by the single-device blockwise kernel
+    and the ring (the only difference between them is where the next block
+    comes from)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_j,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, q_off, k_off)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l = l * alpha + p.sum(axis=-1)
+    o = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_j.astype(jnp.float32))
+    return o, m_new, l
+
+
+def _flash_finish(o, l, dtype):
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(dtype)
+
+
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         block_k: int = 512, causal: bool = False) -> jax.Array:
     """Flash-style single-device attention: stream over K/V blocks with the
@@ -66,6 +91,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     materializes. O(L * block_k) memory; exact (not approximate)."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    block_k = min(block_k, lk)    # short sequences: one block
     if lk % block_k:
         raise ValueError(f"seq len {lk} not divisible by block_k {block_k}")
     n_blocks = lk // block_k
@@ -74,19 +100,10 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     vb = v.reshape(b, n_blocks, block_k, h, d)
 
     def step(carry, xs):
-        o, m, l = carry
         j, k_j, v_j = xs
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_j,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, 0, j * block_k)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l = l * alpha + p.sum(axis=-1)
-        o = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_j.astype(jnp.float32))
-        return (o, m_new, l), None
+        o, m, l = _flash_step(q, k_j, v_j, *carry, 0, j * block_k,
+                              causal, scale)
+        return (o, m, l), None
 
     o0 = jnp.zeros((b, h, lq, d), jnp.float32)
     m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
@@ -94,8 +111,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (o, _, l), _ = jax.lax.scan(
         step, (o0, m0, l0),
         (jnp.arange(n_blocks), jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0)))
-    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
-    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+    return _flash_finish(o, l, q.dtype)
 
 
 def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
@@ -111,21 +127,13 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
         o, m, l, k_t, v_t = carry
         # device r holds the kv block originally on device (r + t) mod p
         k_off = ((r + t) % p_size) * lk
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_t,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, q_off, k_off)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        pr = jnp.exp(s - m_new[..., None])
-        l = l * alpha + pr.sum(axis=-1)
-        o = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", pr, v_t.astype(jnp.float32))
+        o, m, l = _flash_step(q, k_t, v_t, o, m, l, q_off, k_off,
+                              causal, scale)
         # rotate: receive the next block from the right neighbor
         perm = [(i, (i - 1) % p_size) for i in range(p_size)]
         k_t = jax.lax.ppermute(k_t, axis, perm)
         v_t = jax.lax.ppermute(v_t, axis, perm)
-        return (o, m_new, l, k_t, v_t), None
+        return (o, m, l, k_t, v_t), None
 
     # zero-init carries must be marked device-varying over the ring axis or
     # scan rejects the carry type under shard_map
@@ -137,8 +145,7 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     l0 = _vary(jnp.zeros((b, h, lq), jnp.float32))
     (o, _, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(p_size))
-    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
-    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+    return _flash_finish(o, l, q.dtype)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,

@@ -19,6 +19,9 @@ from predictionio_tpu import __version__
 @click.group()
 def cli():
     """predictionio_tpu — TPU-native ML server framework."""
+    from predictionio_tpu.utils.config import honor_jax_platforms
+
+    honor_jax_platforms()
 
 
 @cli.command()
@@ -620,6 +623,7 @@ def template_list():
         "similarproduct": "predictionio_tpu.engines.similarproduct:engine",
         "classification": "predictionio_tpu.engines.classification:engine",
         "ecommerce": "predictionio_tpu.engines.ecommerce:engine",
+        "sessionrec": "predictionio_tpu.engines.sessionrec:engine",
     }
     for name, factory in templates.items():
         click.echo(f"[INFO] {name:<16} {factory}")
@@ -649,6 +653,12 @@ def template_get(name, directory):
                       {"app_name": "MyApp"},
                       [{"name": "ecomm",
                         "params": {"app_name": "MyApp", "rank": 10}}]),
+        "sessionrec": ("predictionio_tpu.engines.sessionrec:engine",
+                       {"app_name": "MyApp"},
+                       [{"name": "seqrec",
+                         "params": {"d_model": 64, "n_heads": 2,
+                                    "n_layers": 2, "max_len": 32,
+                                    "epochs": 10}}]),
     }
     if name not in factories:
         click.echo(f"[ERROR] Unknown template {name}. "

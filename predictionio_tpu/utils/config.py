@@ -11,6 +11,21 @@ import os
 from typing import Dict
 
 
+def honor_jax_platforms() -> None:
+    """Make the JAX_PLATFORMS env var authoritative: device plugins (e.g. a
+    tunneled TPU) would otherwise override it and can hang the process when
+    the remote chip is unreachable. Must run before jax backend init."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass
+
+
 def pio_home() -> str:
     return os.environ.get(
         "PIO_HOME", os.path.join(os.path.expanduser("~"), ".pio_tpu"))

@@ -73,3 +73,55 @@ def test_ulysses_rejects_indivisible_heads(mesh8):
     x = jnp.asarray(rng.normal(size=(1, 64, 4, 8)).astype(np.float32))
     with pytest.raises(ValueError, match="heads"):
         ulysses_attention(x, x, x, mesh8, axis="data")
+
+
+def test_key_mask_blocks_padding_keys():
+    """Left-padded keys must not receive softmax mass (SASRec pad bug)."""
+    rng = np.random.default_rng(9)
+    b, l, h, d = 2, 16, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(b, l, h, d)).astype(np.float32))
+               for _ in range(3))
+    key_mask = jnp.asarray(np.arange(l)[None, :] >= 6).repeat(b, axis=0)
+    dense = mha(q, k, v, causal=True, key_mask=key_mask)
+    block = blockwise_attention(q, k, v, block_k=4, causal=True,
+                                key_mask=key_mask)
+    np.testing.assert_allclose(np.asarray(block), np.asarray(dense),
+                               atol=1e-5)
+    # masked-out keys must not influence output: zero the padded K/V rows
+    k2 = k.at[:, :6].set(0.0)
+    v2 = v.at[:, :6].set(99.0)
+    block2 = blockwise_attention(q, k2, v2, block_k=4, causal=True,
+                                 key_mask=key_mask)
+    np.testing.assert_allclose(np.asarray(block2), np.asarray(block),
+                               atol=1e-5)
+
+
+def test_ring_and_ulysses_key_mask(mesh8):
+    rng = np.random.default_rng(10)
+    b, l, h, d = 2, 64, 8, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(b, l, h, d)).astype(np.float32))
+               for _ in range(3))
+    key_mask = jnp.asarray(np.arange(l)[None, :] >= 24).repeat(b, axis=0)
+    dense = mha(q, k, v, causal=True, key_mask=key_mask)
+    ring = ring_attention(q, k, v, mesh8, axis="data", causal=True,
+                          key_mask=key_mask)
+    uly = ulysses_attention(q, k, v, mesh8, axis="data", causal=True,
+                            key_mask=key_mask)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense), atol=1e-5)
+
+
+def test_blockwise_non_divisible_block_k():
+    q, k, v = qkv(seed=11, l=60)   # 60 not divisible by default 512
+    dense = mha(q, k, v, causal=True)
+    block = blockwise_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(block), np.asarray(dense),
+                               atol=1e-5)
+
+
+def test_blockwise_prime_length_padded_blocks():
+    q, k, v = qkv(seed=12, l=61)   # prime length exercises K/V padding
+    dense = mha(q, k, v, causal=True)
+    block = blockwise_attention(q, k, v, block_k=16, causal=True)
+    np.testing.assert_allclose(np.asarray(block), np.asarray(dense),
+                               atol=1e-5)

@@ -306,8 +306,13 @@ def _load_engine_variant(variant_path):
 @click.option("--mesh-shape", default=None,
               help="Device mesh shape, e.g. 8 or 4,2.")
 @click.option("--mesh-axes", default=None, help="Mesh axis names, e.g. data,model.")
+@click.option("--checkpoint-dir", default=None,
+              help="Mid-training checkpoint/resume directory.")
+@click.option("--checkpoint-interval", default=10, type=int,
+              help="Iterations/epochs between snapshots.")
 def train(variant, batch, skip_sanity_check, stop_after_read,
-          stop_after_prepare, mesh_shape, mesh_axes):
+          stop_after_prepare, mesh_shape, mesh_axes, checkpoint_dir,
+          checkpoint_interval):
     """Train an engine instance (Console.scala:179, CoreWorkflow.runTrain)."""
     from predictionio_tpu.workflow import WorkflowParams, run_train
 
@@ -318,6 +323,9 @@ def train(variant, batch, skip_sanity_check, stop_after_read,
         runtime_conf["mesh_shape"] = mesh_shape
     if mesh_axes:
         runtime_conf["mesh_axes"] = mesh_axes
+    if checkpoint_dir:
+        runtime_conf["checkpoint_dir"] = checkpoint_dir
+        runtime_conf["checkpoint_interval"] = str(checkpoint_interval)
     wp = WorkflowParams(
         batch=batch, skip_sanity_check=skip_sanity_check,
         stop_after_read=stop_after_read,

@@ -144,10 +144,20 @@ class Engine:
 
         named_algos = self._algorithms(engine_params)
         models = []
-        for name, algo in named_algos:
+        shared_ckpt = getattr(ctx, "checkpointer", None)
+        for i, (name, algo) in enumerate(named_algos):
             logger.info("training algorithm %s (%s)",
                         name or "<default>", type(algo).__name__)
-            model = algo.train(ctx, pd)
+            if shared_ckpt is not None:
+                # per-algorithm namespace: algorithm i must never resume
+                # from algorithm j's snapshots
+                ctx.checkpointer = shared_ckpt.scoped(
+                    f"algo_{i}_{name or type(algo).__name__}")
+            try:
+                model = algo.train(ctx, pd)
+            finally:
+                if shared_ckpt is not None:
+                    ctx.checkpointer = shared_ckpt
             _sanity(model, f"model of {name or type(algo).__name__}",
                     skip_sanity_check)
             models.append(model)

@@ -183,7 +183,10 @@ class ALSAlgorithm(Algorithm):
             seed=self.params.seed,
             implicit_prefs=self.params.implicit_prefs,
             alpha=self.params.alpha)
-        U, V = train_als(mesh, data, als_params)
+        from predictionio_tpu.workflow.checkpoint import checkpointer_of
+
+        U, V = train_als(mesh, data, als_params,
+                         checkpointer=checkpointer_of(ctx))
         return ALSModel(user_vocab=user_vocab, item_vocab=item_vocab, U=U, V=V)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:

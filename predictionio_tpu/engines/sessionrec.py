@@ -143,9 +143,11 @@ class SeqRecAlgorithm(Algorithm):
         self.params = params or AlgorithmParams()
 
     def train(self, ctx, pd: PreparedData) -> SeqRecModel:
+        from predictionio_tpu.workflow.checkpoint import checkpointer_of
         from predictionio_tpu.workflow.context import mesh_of
 
-        return train_seqrec(mesh_of(ctx), pd.sessions, self.params)
+        return train_seqrec(mesh_of(ctx), pd.sessions, self.params,
+                            checkpointer=checkpointer_of(ctx))
 
     def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:
         recs = model.recommend_next(query.items, query.num)

@@ -234,19 +234,12 @@ def _half_sweep(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
     return batched_spd_solve(A, rhs)
 
 
-def make_train_fn(mesh: Mesh, data_dims, params: ALSParams):
-    """Build the jitted full training function for the given mesh.
-
-    Returns train(by_user_arrays, by_item_arrays, key) -> (U, V), where the
-    per-shard COO arrays are sharded over the mesh's "data" axis and the
-    factor matrices flow replicated-in / sharded-out; XLA inserts the
-    all-gather between half-sweeps (collectives over ICI).
-    """
+def _make_sweeps(mesh: Mesh, data_dims, params: ALSParams):
+    """Build the shard_map'd user/item half-sweeps for the given mesh."""
     from jax import shard_map
 
     n_users_pad, n_items_pad, ups, ips = data_dims
     axis = "data"
-    k = params.rank
     chunk = params.chunk_size
 
     def user_block(V, tgt, seg, val, w):
@@ -269,12 +262,20 @@ def make_train_fn(mesh: Mesh, data_dims, params: ALSParams):
         item_block, mesh=mesh,
         in_specs=(P(), row_spec, seg_spec, row_spec, row_spec),
         out_specs=P(axis, None, None), check_vma=False)
+    return user_sweep, item_sweep
 
-    def train(by_user, by_item, key):
+
+def _make_chunk_core(mesh: Mesh, data_dims, params: ALSParams, iters: int):
+    """Shared iteration body: (by_user, by_item, V) -> (U, V) after `iters`
+    alternating sweeps. Both the straight and the checkpointed paths run
+    exactly this, so they cannot drift apart."""
+    n_users_pad, n_items_pad, _, _ = data_dims
+    k = params.rank
+    user_sweep, item_sweep = _make_sweeps(mesh, data_dims, params)
+
+    def chunk(by_user, by_item, V):
         u_tgt, u_seg, u_val, u_w = by_user
         i_tgt, i_seg, i_val, i_w = by_item
-        V = (jax.random.normal(key, (n_items_pad, k), jnp.float32)
-             / jnp.sqrt(jnp.asarray(k, jnp.float32)))
 
         def body(_, carry):
             U, V = carry
@@ -283,10 +284,36 @@ def make_train_fn(mesh: Mesh, data_dims, params: ALSParams):
             return (U, V)
 
         U0 = jnp.zeros((n_users_pad, k), jnp.float32)
-        U, V = jax.lax.fori_loop(0, params.num_iterations, body, (U0, V))
-        return U, V
+        return jax.lax.fori_loop(0, iters, body, (U0, V))
+
+    return chunk
+
+
+def make_train_fn(mesh: Mesh, data_dims, params: ALSParams):
+    """Build the jitted full training function for the given mesh.
+
+    Returns train(by_user_arrays, by_item_arrays, key) -> (U, V), where the
+    per-shard COO arrays are sharded over the mesh's "data" axis and the
+    factor matrices flow replicated-in / sharded-out; XLA inserts the
+    all-gather between half-sweeps (collectives over ICI).
+    """
+    _, n_items_pad, _, _ = data_dims
+    k = params.rank
+    chunk = _make_chunk_core(mesh, data_dims, params, params.num_iterations)
+
+    def train(by_user, by_item, key):
+        V = (jax.random.normal(key, (n_items_pad, k), jnp.float32)
+             / jnp.sqrt(jnp.asarray(k, jnp.float32)))
+        return chunk(by_user, by_item, V)
 
     return jax.jit(train)
+
+
+def make_chunk_fn(mesh: Mesh, data_dims, params: ALSParams, iters: int):
+    """Like make_train_fn but runs `iters` iterations from a given V —
+    the unit of mid-training checkpointing (train_als drives the outer
+    loop, snapshotting V between chunks)."""
+    return jax.jit(_make_chunk_core(mesh, data_dims, params, iters))
 
 
 #: memoized jitted train fns — rebuilding the closures on every call would
@@ -298,17 +325,22 @@ _TRAIN_FN_CACHE: "OrderedDict" = None
 _TRAIN_FN_CACHE_MAX = 8
 
 
-def _cached_train_fn(mesh: Mesh, data_dims, params: ALSParams):
+def _cached_train_fn(mesh: Mesh, data_dims, params: ALSParams,
+                     chunk_iters: Optional[int] = None):
     global _TRAIN_FN_CACHE
     from collections import OrderedDict
 
     if _TRAIN_FN_CACHE is None:
         _TRAIN_FN_CACHE = OrderedDict()
     key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
-           mesh.axis_names, data_dims, dataclasses.astuple(params))
+           mesh.axis_names, data_dims, dataclasses.astuple(params),
+           chunk_iters)
     fn = _TRAIN_FN_CACHE.get(key)
     if fn is None:
-        fn = make_train_fn(mesh, data_dims, params)
+        if chunk_iters is None:
+            fn = make_train_fn(mesh, data_dims, params)
+        else:
+            fn = make_chunk_fn(mesh, data_dims, params, chunk_iters)
         _TRAIN_FN_CACHE[key] = fn
         while len(_TRAIN_FN_CACHE) > _TRAIN_FN_CACHE_MAX:
             _TRAIN_FN_CACHE.popitem(last=False)
@@ -317,19 +349,51 @@ def _cached_train_fn(mesh: Mesh, data_dims, params: ALSParams):
     return fn
 
 
-def train_als(mesh: Mesh, data: ALSData, params: ALSParams
-              ) -> Tuple[np.ndarray, np.ndarray]:
-    """Train and return host (U [n_users, K], V [n_items, K])."""
+def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
+              checkpointer=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Train and return host (U [n_users, K], V [n_items, K]).
+
+    With a `workflow.checkpoint.Checkpointer`, iterations run in chunks of
+    `checkpointer.interval`, snapshotting the item factors between chunks
+    (the ALS state is fully determined by V — each sweep recomputes U from
+    it); a crashed/preempted run resumes from the latest snapshot, even on
+    a different mesh shape (snapshots hold unpadded host arrays)."""
     n_shards = int(np.prod(mesh.devices.shape))
     assert data.by_user.tgt.shape[0] == n_shards, \
         f"data built for {data.by_user.tgt.shape[0]} shards, mesh has {n_shards}"
-    train = _cached_train_fn(
-        mesh, (data.n_users_pad, data.n_items_pad,
-               data.by_user.seg_per_shard, data.by_item.seg_per_shard), params)
+    dims = (data.n_users_pad, data.n_items_pad,
+            data.by_user.seg_per_shard, data.by_item.seg_per_shard)
     key = jax.random.PRNGKey(params.seed)
     bu = (data.by_user.tgt, data.by_user.seg, data.by_user.val, data.by_user.w)
     bi = (data.by_item.tgt, data.by_item.seg, data.by_item.val, data.by_item.w)
-    U, V = train(bu, bi, key)
+
+    if checkpointer is None:
+        train = _cached_train_fn(mesh, dims, params)
+        U, V = train(bu, bi, key)
+    else:
+        k = params.rank
+        snap = checkpointer.latest()
+        it = 0
+        V = None
+        if snap is not None and snap[1].get("V") is not None \
+                and snap[1]["V"].shape == (data.n_items, k) \
+                and snap[0] < params.num_iterations:
+            # a snapshot at/past the target (stale run with fewer iters)
+            # would skip the loop and leave U zeroed — retrain instead
+            it, state = snap
+            V = jnp.zeros((data.n_items_pad, k), jnp.float32)
+            V = V.at[:data.n_items].set(jnp.asarray(state["V"]))
+        if V is None:
+            V = (jax.random.normal(key, (data.n_items_pad, k), jnp.float32)
+                 / jnp.sqrt(jnp.asarray(k, jnp.float32)))
+        U = jnp.zeros((data.n_users_pad, k), jnp.float32)
+        while it < params.num_iterations:
+            n = min(checkpointer.interval, params.num_iterations - it)
+            chunk = _cached_train_fn(mesh, dims, params, chunk_iters=n)
+            U, V = chunk(bu, bi, V)
+            it += n
+            if it < params.num_iterations:
+                checkpointer.save(it, {"V": V[:data.n_items]})
     U = np.asarray(jax.device_get(U))[:data.n_users]
     V = np.asarray(jax.device_get(V))[:data.n_items]
     return U, V

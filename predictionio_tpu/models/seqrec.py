@@ -232,9 +232,11 @@ def _predict_hidden(params, seqs, n_heads):
 
 
 def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
-                 p: SeqRecParams) -> SeqRecModel:
+                 p: SeqRecParams, checkpointer=None) -> SeqRecModel:
     """End-to-end: id-assign, pad, adamw train, return pickled-friendly
-    model. `sessions` are per-user time-ordered item-id lists."""
+    model. `sessions` are per-user time-ordered item-id lists. With a
+    `workflow.checkpoint.Checkpointer`, (params, opt_state) snapshot every
+    `interval` epochs and a preempted run resumes from the latest one."""
     import optax
 
     all_items = np.asarray(sorted({it for s in sessions for it in s}),
@@ -248,23 +250,49 @@ def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
     rng = np.random.default_rng(p.seed)
     tp = mesh.shape.get("model", 1) if mesh is not None else 1
     params = init_params(rng, len(all_items), p, vocab_multiple=tp)
+    epoch0 = 0
+    restored_opt = None
+    snap = checkpointer.latest() if checkpointer is not None else None
+    if snap is not None and "params" in snap[1]:
+        e, state = snap
+        restored = jax.tree.map(jnp.asarray, state["params"])
+        same = jax.tree.structure(restored) == jax.tree.structure(params) \
+            and all(a.shape == b.shape for a, b in
+                    zip(jax.tree.leaves(restored), jax.tree.leaves(params)))
+        if same:
+            epoch0, params = e, restored
+            restored_opt = state["opt_state"]
+    # shard BEFORE optimizer.init so adamw's mu/nu inherit the tp layout
+    # (a replicated opt state would double-replicate the embedding table)
     if mesh is not None and "model" in mesh.axis_names:
         params = shard_params(params, mesh)
     optimizer = optax.adamw(p.learning_rate)
     opt_state = optimizer.init(params)
+    if restored_opt is not None:
+        opt_state = jax.tree.map(
+            lambda init_leaf, saved: jax.device_put(
+                jnp.asarray(saved), init_leaf.sharding)
+            if hasattr(init_leaf, "sharding") else saved,
+            opt_state, restored_opt)
     step = make_train_step(mesh, p, optimizer)
 
     n = len(inputs)
     bs = min(p.batch_size, n)
-    order = np.arange(n)
-    loss = None
-    for _ in range(p.epochs):
-        rng.shuffle(order)
+    for epoch in range(epoch0, p.epochs):
+        # shuffle a FRESH arange keyed by epoch: a resumed run replays the
+        # identical batch order the uninterrupted run would have used
+        order = np.arange(n)
+        np.random.default_rng(p.seed + epoch).shuffle(order)
         for lo in range(0, n - bs + 1, bs):
             idx = order[lo:lo + bs]
-            params, opt_state, loss = step(
+            params, opt_state, _loss = step(
                 params, opt_state, jnp.asarray(inputs[idx]),
                 jnp.asarray(targets[idx]))
+        done = epoch + 1
+        if checkpointer is not None and checkpointer.due(done) \
+                and done < p.epochs:
+            checkpointer.save(done, {"params": params,
+                                     "opt_state": opt_state})
     del opt_state
     host = jax.tree.map(np.asarray, params)
     return SeqRecModel(item_vocab=all_items, params=host, hyper=p)

@@ -60,6 +60,9 @@ class WorkflowContext:
         self._mesh_shape = tuple(mesh_shape) if mesh_shape else None
         self._mesh_axes = tuple(mesh_axes) if mesh_axes else None
         self._devices = devices
+        #: mid-training Checkpointer (workflow/checkpoint.py), set from
+        #: runtime_conf checkpoint_dir/checkpoint_interval; None = off
+        self.checkpointer = None
         logger.info("WorkflowContext: mode=%s batch=%s", mode, batch)
 
     # -- mesh ---------------------------------------------------------------
@@ -116,5 +119,12 @@ class WorkflowContext:
         mesh_axes = conf.get("mesh_axes")
         if isinstance(mesh_axes, str):
             mesh_axes = [x for x in mesh_axes.split(",") if x]
-        return cls(mode=mode, batch=batch, mesh_shape=mesh_shape,
-                   mesh_axes=mesh_axes, devices=devices)
+        ctx = cls(mode=mode, batch=batch, mesh_shape=mesh_shape,
+                  mesh_axes=mesh_axes, devices=devices)
+        ckpt_dir = conf.get("checkpoint_dir")
+        if ckpt_dir:
+            from predictionio_tpu.workflow.checkpoint import Checkpointer
+
+            ctx.checkpointer = Checkpointer(
+                ckpt_dir, interval=int(conf.get("checkpoint_interval", 10)))
+        return ctx

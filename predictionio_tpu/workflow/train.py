@@ -80,6 +80,10 @@ def run_train(engine: Engine,
     instance.status = "COMPLETED"
     instance.end_time = _dt.datetime.now(tz=UTC)
     instances.update(instance)
+    if getattr(ctx, "checkpointer", None) is not None:
+        # resume is for crashed/preempted runs only: a completed run clears
+        # its snapshots so the next train never resumes from stale factors
+        ctx.checkpointer.clear()
     logger.info("training completed: instance %s", instance_id)
     return instance
 

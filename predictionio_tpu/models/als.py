@@ -172,6 +172,10 @@ class ALSData:
     n_users_pad: int
     n_items_pad: int
     nnz: int
+    #: digest of the PRE-shard COO triples — mesh-shape independent, so a
+    #: checkpoint fingerprint built from it survives resuming on a
+    #: different device count (the padded row layout does not)
+    digest: str = ""
 
     @classmethod
     def build(cls, user_idx: np.ndarray, item_idx: np.ndarray,
@@ -185,7 +189,8 @@ class ALSData:
                    n_users=n_users, n_items=n_items,
                    n_users_pad=by_user.n_segments,
                    n_items_pad=by_item.n_segments,
-                   nnz=int(len(ratings)))
+                   nnz=int(len(ratings)),
+                   digest=coo_digest(user_idx, item_idx, ratings))
 
 
 # ---------------------------------------------------------------------------
@@ -349,6 +354,44 @@ def _cached_train_fn(mesh: Mesh, data_dims, params: ALSParams,
     return fn
 
 
+def coo_digest(user_idx: np.ndarray, item_idx: np.ndarray,
+               ratings: np.ndarray) -> str:
+    """Identity hash of the FULL rating set (canonical dtypes, so int32 vs
+    int64 inputs digest identically). Full, not sampled: a checkpoint
+    resumed against data where even one rating changed must retrain, and
+    blake2b at a few hundred MB/s is noise next to the argsorts
+    ALSData.build already does over the same arrays."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.asarray([len(ratings)], np.int64).tobytes())
+    for arr, dt in ((user_idx, np.int64), (item_idx, np.int64),
+                    (ratings, np.float32)):
+        h.update(np.ascontiguousarray(
+            np.asarray(arr).reshape(-1).astype(dt)).tobytes())
+    return h.hexdigest()
+
+
+def als_fingerprint(data: ALSData, params: ALSParams) -> str:
+    """Identity of a training run for checkpoint-resume safety: math-shaping
+    hyperparams (num_iterations/chunk_size excluded — more iterations on the
+    same run IS the resume use case) + dataset stats + the mesh-independent
+    COO digest (NOT the padded row layout, which varies with shard count —
+    snapshots must survive resuming on a different mesh shape). A crashed
+    run restarted with different reg/seed/alpha/implicit_prefs, or against
+    different ratings of the same shape, retrains from scratch."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((params.rank, params.reg, params.alpha,
+                   params.implicit_prefs, params.weighted_reg,
+                   params.seed)).encode())
+    h.update(np.asarray([data.nnz, data.n_users, data.n_items],
+                        np.int64).tobytes())
+    h.update(data.digest.encode())
+    return h.hexdigest()
+
+
 def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
               checkpointer=None) -> Tuple[np.ndarray, np.ndarray]:
     """Train and return host (U [n_users, K], V [n_items, K]).
@@ -372,7 +415,8 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
         U, V = train(bu, bi, key)
     else:
         k = params.rank
-        snap = checkpointer.latest()
+        fp = als_fingerprint(data, params)
+        snap = checkpointer.latest(fingerprint=fp)
         it = 0
         V = None
         if snap is not None and snap[1].get("V") is not None \
@@ -393,7 +437,8 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
             U, V = chunk(bu, bi, V)
             it += n
             if it < params.num_iterations:
-                checkpointer.save(it, {"V": V[:data.n_items]})
+                checkpointer.save(it, {"V": V[:data.n_items]},
+                                  fingerprint=fp)
     U = np.asarray(jax.device_get(U))[:data.n_users]
     V = np.asarray(jax.device_get(V))[:data.n_items]
     return U, V

@@ -231,6 +231,27 @@ def _predict_hidden(params, seqs, n_heads):
     return forward(params, seqs, n_heads)
 
 
+def seqrec_fingerprint(item_vocab: np.ndarray, p: SeqRecParams,
+                       sessions: Sequence[Sequence[str]] = ()) -> str:
+    """Identity of a seqrec run for checkpoint-resume safety: every
+    hyperparam that shapes the trajectory (epochs excluded — training
+    further IS the resume use case) + the full item vocabulary + the
+    training sessions themselves. Guards against resuming onto a changed
+    item set/order of the same size (embeddings silently mapped to wrong
+    item codes), changed learning_rate/seed, or an event store whose
+    interactions changed while the vocab did not."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((p.d_model, p.n_heads, p.n_layers, p.max_len,
+                   p.learning_rate, p.batch_size, p.seed)).encode())
+    h.update("\x00".join(str(it) for it in item_vocab).encode())
+    for s in sessions:
+        h.update("\x00".join(str(it) for it in s).encode())
+        h.update(b"\x01")
+    return h.hexdigest()
+
+
 def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
                  p: SeqRecParams, checkpointer=None) -> SeqRecModel:
     """End-to-end: id-assign, pad, adamw train, return pickled-friendly
@@ -250,9 +271,11 @@ def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
     rng = np.random.default_rng(p.seed)
     tp = mesh.shape.get("model", 1) if mesh is not None else 1
     params = init_params(rng, len(all_items), p, vocab_multiple=tp)
+    fp = seqrec_fingerprint(all_items, p, sessions)
     epoch0 = 0
-    restored_opt = None
-    snap = checkpointer.latest() if checkpointer is not None else None
+    restored_opt_leaves = None
+    snap = checkpointer.latest(fingerprint=fp) \
+        if checkpointer is not None else None
     if snap is not None and "params" in snap[1]:
         e, state = snap
         restored = jax.tree.map(jnp.asarray, state["params"])
@@ -261,19 +284,33 @@ def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
                     zip(jax.tree.leaves(restored), jax.tree.leaves(params)))
         if same:
             epoch0, params = e, restored
-            restored_opt = state["opt_state"]
+            restored_opt_leaves = state.get("opt_leaves")
     # shard BEFORE optimizer.init so adamw's mu/nu inherit the tp layout
     # (a replicated opt state would double-replicate the embedding table)
     if mesh is not None and "model" in mesh.axis_names:
         params = shard_params(params, mesh)
     optimizer = optax.adamw(p.learning_rate)
     opt_state = optimizer.init(params)
-    if restored_opt is not None:
-        opt_state = jax.tree.map(
-            lambda init_leaf, saved: jax.device_put(
-                jnp.asarray(saved), init_leaf.sharding)
-            if hasattr(init_leaf, "sharding") else saved,
-            opt_state, restored_opt)
+    if restored_opt_leaves is not None:
+        # snapshots hold the opt state as a flat leaf list (numpy-only
+        # pytrees survive the restricted snapshot unpickler); rebuild it
+        # against the freshly-initialized state's structure + sharding
+        treedef = jax.tree.structure(opt_state)
+        if treedef.num_leaves == len(restored_opt_leaves):
+            saved = jax.tree.unflatten(treedef, restored_opt_leaves)
+            opt_state = jax.tree.map(
+                lambda init_leaf, s: jax.device_put(
+                    jnp.asarray(s), init_leaf.sharding)
+                if hasattr(init_leaf, "sharding") else s,
+                opt_state, saved)
+        else:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "seqrec snapshot optimizer state has %d leaves, current "
+                "optimizer expects %d (optax layout change?) — resuming "
+                "params at epoch %d with RESET adam moments",
+                len(restored_opt_leaves), treedef.num_leaves, epoch0)
     step = make_train_step(mesh, p, optimizer)
 
     n = len(inputs)
@@ -292,7 +329,8 @@ def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
         if checkpointer is not None and checkpointer.due(done) \
                 and done < p.epochs:
             checkpointer.save(done, {"params": params,
-                                     "opt_state": opt_state})
+                                     "opt_leaves": jax.tree.leaves(opt_state)},
+                              fingerprint=fp)
     del opt_state
     host = jax.tree.map(np.asarray, params)
     return SeqRecModel(item_vocab=all_items, params=host, hyper=p)

@@ -101,7 +101,8 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     running-max/denominator recurrence so the [Lq, Lk] score matrix never
     materializes. O(L * block_k) memory; exact (not approximate).
     key_mask: optional [B, Lk] bool, False = key is padding (ignored).
-    block_k is clamped to the largest divisor of the sequence length."""
+    Sequence lengths that are not a block_k multiple are handled by padding
+    K/V up to one and masking the pad keys out."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     block_k = min(block_k, lk)

@@ -248,11 +248,14 @@ class QueryServer:
         return params_from_json(body, qc)
 
     def _vectorized(self) -> bool:
-        """Micro-batching only pays when some algorithm overrides
-        batch_predict with a device-batched implementation."""
+        """Micro-batching only pays when EVERY algorithm overrides
+        batch_predict with a device-batched implementation — with a mix,
+        the non-vectorized algorithms would run their serial per-query
+        loop inside the single batcher worker, which is slower than the
+        per-request thread-pool path."""
         from predictionio_tpu.core.base import Algorithm
 
-        return any(
+        return bool(self.result.algorithms) and all(
             type(a).batch_predict is not Algorithm.batch_predict
             for a in self.result.algorithms)
 

@@ -13,10 +13,26 @@ a crash mid-save never corrupts the latest good snapshot; `latest()` picks
 the highest step. Snapshots hold host numpy pytrees (device arrays are
 pulled to host), so they are mesh-shape independent: a run checkpointed on
 8 chips can resume on 1 and vice versa.
+
+Two safety properties:
+
+* **Fingerprinted resume.** A snapshot can carry a `fingerprint` (hash of
+  hyperparams + dataset identity, computed by the algorithm). `latest()`
+  called with a fingerprint ignores snapshots whose fingerprint differs —
+  so a crashed run restarted with different reg/seed/alpha, or against
+  different data of the same shape, retrains from scratch instead of
+  silently resuming from incompatible factors.
+* **Restricted deserialization.** Snapshots are loaded with an unpickler
+  that only resolves numpy array machinery and builtin containers —
+  a writable checkpoint directory does not grant code execution in the
+  training process (checkpoint dirs on shared/preemptible fleets have a
+  weaker trust boundary than the model store). Algorithms therefore save
+  plain pytrees of dict/list/tuple/ndarray/scalars only.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import re
@@ -24,7 +40,50 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
-_SNAP_RE = re.compile(r"^step_(\d+)\.pkl$")
+logger = logging.getLogger(__name__)
+
+#: step_<N>.pkl (no lineage tag) or step_<N>.<fp8>.pkl — the tag is the
+#: first 8 hex chars of the run fingerprint, letting GC and resume treat
+#: each run lineage independently without opening the files
+_SNAP_RE = re.compile(r"^step_(\d+)(?:\.([0-9a-f]{8}))?\.pkl$")
+
+#: exact (module, name) pairs the snapshot unpickler may resolve — the
+#: ndarray reconstruction machinery only. Deliberately NOT whole modules:
+#: e.g. `numpy.load` with allow_pickle would reopen the door to arbitrary
+#: code execution via a second attacker-written file.
+_SAFE_SYMBOLS = {
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if (module, name) in _SAFE_SYMBOLS or \
+                (module == "numpy.dtypes" and name.endswith("DType")):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"snapshot references forbidden symbol {module}.{name}; "
+            "checkpoints may only contain numpy pytrees")
+
+
+def _safe_load(f) -> Any:
+    return _RestrictedUnpickler(f).load()
+
+
+def _tag(fingerprint: Optional[str]) -> Optional[str]:
+    """8-hex-char filename tag for a run fingerprint (hashed, so any
+    string works, not just hexdigests)."""
+    if fingerprint is None:
+        return None
+    import hashlib
+
+    return hashlib.blake2b(fingerprint.encode(),
+                           digest_size=4).hexdigest()
 
 
 class Checkpointer:
@@ -37,8 +96,19 @@ class Checkpointer:
         self.keep = max(int(keep), 1)
         os.makedirs(directory, exist_ok=True)
 
-    def _path(self, step: int) -> str:
-        return os.path.join(self.directory, f"step_{step}.pkl")
+    def _path(self, step: int, fingerprint: Optional[str] = None) -> str:
+        t = _tag(fingerprint)
+        return os.path.join(self.directory,
+                            f"step_{step}{'.' + t if t else ''}.pkl")
+
+    def _scan(self):
+        """[(step, tag_or_None, filename)] for every snapshot present."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _SNAP_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), m.group(2), name))
+        return out
 
     def due(self, step: int) -> bool:
         return step > 0 and step % self.interval == 0
@@ -50,32 +120,74 @@ class Checkpointer:
         return Checkpointer(os.path.join(self.directory, name),
                             interval=self.interval, keep=self.keep)
 
-    def save(self, step: int, state: Any) -> None:
-        """state: any picklable pytree; device arrays are host-copied."""
+    def save(self, step: int, state: Any,
+             fingerprint: Optional[str] = None) -> None:
+        """state: a pytree of dict/list/tuple/ndarray/scalars; device
+        arrays are host-copied. `fingerprint` ties the snapshot to the
+        (hyperparams, dataset) that produced it — see `latest`."""
         import jax
 
         host = jax.tree.map(
             lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
-        tmp = self._path(step) + ".tmp"
+        path = self._path(step, fingerprint)
+        tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump({"step": step, "state": host}, f)
+            pickle.dump({"step": step, "state": host,
+                         "fingerprint": fingerprint}, f)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, self._path(step))
-        self._gc()
+        os.replace(tmp, path)
+        self._gc(fingerprint)
 
-    def latest(self) -> Optional[Tuple[int, Any]]:
-        """(step, state) of the newest snapshot, or None."""
-        best = -1
-        for name in os.listdir(self.directory):
-            m = _SNAP_RE.match(name)
-            if m:
-                best = max(best, int(m.group(1)))
-        if best < 0:
-            return None
-        with open(self._path(best), "rb") as f:
-            snap = pickle.load(f)
-        return snap["step"], snap["state"]
+    def latest(self, fingerprint: Optional[str] = None
+               ) -> Optional[Tuple[int, Any]]:
+        """(step, state) of the newest readable, compatible snapshot.
+
+        Scans steps newest-first. Unreadable, malformed, or forbidden
+        snapshots are skipped with a warning; so are snapshots whose
+        fingerprint differs from (or lacks) the given one — a restarted
+        run whose params or data changed retrains from scratch rather
+        than resuming from incompatible state. Reads never delete: stale
+        lineages are left for their own run (or `clear`) — per-lineage
+        `_gc` means they cannot starve this run's snapshots either."""
+        entries = sorted(self._scan(), reverse=True,
+                         key=lambda e: (e[0], e[1] or "", e[2]))
+        want_tag = _tag(fingerprint)
+        for step, tag, name in entries:
+            path = os.path.join(self.directory, name)
+            if fingerprint is not None and tag is not None \
+                    and tag != want_tag:
+                continue          # other lineage, by filename alone
+            try:
+                with open(path, "rb") as f:
+                    snap = _safe_load(f)
+                if not isinstance(snap, dict):
+                    raise ValueError(f"snapshot is {type(snap).__name__}, "
+                                     "expected dict")
+                step_v, state = snap["step"], snap["state"]
+                # algorithms index into the state dict; a loadable file
+                # with a non-dict state must also degrade to skip, not
+                # crash the caller
+                if not isinstance(state, dict):
+                    raise ValueError(
+                        f"snapshot state is {type(state).__name__}, "
+                        "expected dict")
+            except Exception as e:
+                # the writable-dir threat model again: ANY malformed file
+                # must degrade to "skip + warn", never crash the training
+                # process at resume
+                logger.warning("checkpoint %s unreadable (%s) — skipping",
+                               path, e)
+                continue
+            if fingerprint is not None \
+                    and snap.get("fingerprint") != fingerprint:
+                logger.warning(
+                    "checkpoint %s fingerprint mismatch (snapshot %s, "
+                    "run %s) — ignoring, training from scratch",
+                    path, snap.get("fingerprint"), fingerprint)
+                continue
+            return step_v, state
+        return None
 
     def clear(self) -> None:
         """Remove all snapshots, including per-algorithm scoped subdirs."""
@@ -84,13 +196,17 @@ class Checkpointer:
                 if _SNAP_RE.match(name) or name.endswith(".tmp"):
                     os.unlink(os.path.join(root, name))
 
-    def _gc(self) -> None:
-        steps = sorted(
-            int(m.group(1)) for name in os.listdir(self.directory)
-            if (m := _SNAP_RE.match(name)))
-        for s in steps[:-self.keep]:
+    def _gc(self, fingerprint: Optional[str] = None) -> None:
+        """Keep the newest `keep` snapshots OF THIS LINEAGE (same filename
+        tag); other lineages' files are never touched, so a concurrent or
+        restarted run with different params cannot destroy this run's
+        resume state (nor vice versa)."""
+        tag = _tag(fingerprint)
+        mine = sorted((step, name) for step, t, name in self._scan()
+                      if t == tag)
+        for _step, name in mine[:-self.keep]:
             try:
-                os.unlink(self._path(s))
+                os.unlink(os.path.join(self.directory, name))
             except OSError:
                 pass
 

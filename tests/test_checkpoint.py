@@ -36,6 +36,109 @@ def test_checkpointer_tmp_never_corrupts(tmp_path):
     assert step == 1
 
 
+def test_fingerprint_mismatch_ignores_snapshot(tmp_path):
+    ck = Checkpointer(str(tmp_path), interval=1)
+    ck.save(3, {"x": np.ones(2)}, fingerprint="aaa")
+    assert ck.latest() is not None                 # unfingerprinted read
+    assert ck.latest(fingerprint="aaa")[0] == 3    # matching run resumes
+    assert ck.latest(fingerprint="bbb") is None    # changed run retrains
+    # a newer legacy snapshot without fingerprint can't prove
+    # compatibility: the fingerprinted reader skips it and falls back to
+    # its own lineage's newest snapshot
+    ck.save(4, {"x": np.ones(2)})
+    assert ck.latest(fingerprint="aaa")[0] == 3
+
+
+def test_snapshot_unpickler_rejects_code_execution(tmp_path):
+    """A writable checkpoint dir must not grant code execution: snapshots
+    referencing non-numpy symbols are skipped unexecuted (and a good older
+    snapshot still resumes)."""
+    import os
+    import pickle
+
+    canary = str(tmp_path / "pwned")
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {canary}",))
+
+    ck = Checkpointer(str(tmp_path), interval=1)
+    ck.save(1, {"x": np.ones(2)}, fingerprint="fp")
+    with open(os.path.join(str(tmp_path), "step_2.pkl"), "wb") as f:
+        f.write(pickle.dumps({"step": 2, "state": Evil(),
+                              "fingerprint": "fp"}))
+    step, state = ck.latest(fingerprint="fp")
+    assert step == 1 and state["x"][0] == 1.0
+    assert not os.path.exists(canary), "snapshot payload was executed!"
+    # malformed-but-loadable files (not a dict / missing keys) are also
+    # skipped, not crashed on
+    with open(os.path.join(str(tmp_path), "step_3.pkl"), "wb") as f:
+        f.write(pickle.dumps(np.ones(1)))
+    step, _ = ck.latest(fingerprint="fp")
+    assert step == 1
+
+
+def test_stale_lineage_not_shadowing_not_starving(tmp_path):
+    """A higher-step snapshot from a dead run (different fingerprint) must
+    neither shadow the restarted run's snapshots nor let _gc starve them;
+    reads never delete the other lineage's files."""
+    import os
+
+    ck = Checkpointer(str(tmp_path), interval=1, keep=2)
+    ck.save(8, {"x": np.full(1, 8.0)}, fingerprint="old-run")
+    assert ck.latest(fingerprint="new-run") is None
+    # its own low-step snapshots survive per-lineage _gc and resume
+    ck.save(2, {"x": np.full(1, 2.0)}, fingerprint="new-run")
+    ck.save(3, {"x": np.full(1, 3.0)}, fingerprint="new-run")
+    ck.save(4, {"x": np.full(1, 4.0)}, fingerprint="new-run")
+    step, state = ck.latest(fingerprint="new-run")
+    assert step == 4 and state["x"][0] == 4.0
+    # the dead lineage's snapshot was NOT deleted by reads or by the new
+    # lineage's GC — its own run could still resume it
+    step, state = ck.latest(fingerprint="old-run")
+    assert step == 8 and state["x"][0] == 8.0
+    # per-lineage keep=2: new lineage holds steps 3 and 4 only
+    kept = sorted(n for n in os.listdir(str(tmp_path)))
+    assert len(kept) == 3
+
+
+def test_als_fingerprint_mesh_shape_independent():
+    """Snapshots must survive resuming on a different device count: the
+    fingerprint hashes the pre-shard COO, not the padded row layout."""
+    from predictionio_tpu.models.als import (ALSData, ALSParams,
+                                             als_fingerprint)
+
+    rng = np.random.default_rng(3)
+    users = rng.integers(0, 30, 500).astype(np.int32)
+    items = rng.integers(0, 20, 500).astype(np.int32)
+    ratings = rng.normal(size=500).astype(np.float32)
+    params = ALSParams(rank=4)
+    d1 = ALSData.build(users, items, ratings, 30, 20, n_shards=1)
+    d8 = ALSData.build(users, items, ratings, 30, 20, n_shards=8)
+    assert als_fingerprint(d1, params) == als_fingerprint(d8, params)
+    # ...but different data of the same shape differs
+    d_other = ALSData.build(users, items, ratings + 1.0, 30, 20, n_shards=1)
+    assert als_fingerprint(d1, params) != als_fingerprint(d_other, params)
+
+
+def test_als_changed_params_retrain_from_scratch(tmp_path):
+    """ADVICE r1: a stale snapshot from a run with different reg must not
+    be resumed — the restarted run retrains and matches a straight run."""
+    from predictionio_tpu.models.als import ALSParams, train_als
+
+    data = _als_fixture(seed=2)
+    mesh = _mesh1()
+    ck = Checkpointer(str(tmp_path), interval=2)
+    crashed = ALSParams(rank=6, num_iterations=3, reg=0.5, chunk_size=64)
+    train_als(mesh, data, crashed, checkpointer=ck)   # leaves snapshot @2
+    assert ck.latest() is not None
+    changed = ALSParams(rank=6, num_iterations=6, reg=0.01, chunk_size=64)
+    U_ck, V_ck = train_als(mesh, data, changed, checkpointer=ck)
+    U_straight, V_straight = train_als(mesh, data, changed)
+    np.testing.assert_allclose(U_ck, U_straight, atol=1e-4)
+    np.testing.assert_allclose(V_ck, V_straight, atol=1e-4)
+
+
 def _als_fixture(seed=0):
     from predictionio_tpu.models.als import ALSData
 

@@ -1,25 +1,46 @@
-"""Benchmark: the five judged configs (BASELINE.md) as one suite.
+"""Benchmark: the judged configs (BASELINE.md) as one unkillable suite.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-`value` is total TPU-path wall-clock over all five configs; `vs_baseline`
-is the geometric-mean speedup vs a single-process numpy implementation of
-the same math — the stand-in for the stock Spark-local run (the reference
-publishes no numbers, BASELINE.md). Per-config details go to stderr.
+Design (round-2 rebuild after BENCH_r01 died in backend init):
 
-Configs (BASELINE.json "configs"):
-  1. recommendation ALS, MovieLens-100K shape (943x1682, 100k ratings,
-     rank 10, 20 iters — quickstart engine.json defaults)
-  2. similarproduct cooccurrence, MovieLens-1M shape (6040x3706, 1M events)
-  3. classification NaiveBayes, spam/ham-scale (20k docs x 2k vocab)
-  4. ecommerce implicit-ALS (view+buy confidence weighting) + top-N filter
-  5. evaluation workflow: 3-fold x 3-params cross-validated ALS sweep
+* The orchestrator process NEVER imports jax. Every config — and the
+  backend probe itself — runs in a subprocess with a hard timeout, so a
+  wedged TPU tunnel or a crashing config costs that one subprocess, not
+  the suite: partial results always beat rc=1.
+* Platform resolution: BENCH_PLATFORM env override, else probe the
+  JAX_PLATFORMS platform (the real chip) with retry+backoff, else fall
+  back to CPU. Workers force the platform through jax.config because
+  device plugins override the env var (utils/config.honor_jax_platforms).
+* Baselines are MEASURED single-process numpy runs of the same math (the
+  stand-in for stock Spark-local; the reference publishes no numbers).
+  Only the 20M config extrapolates — linearly from a measured >=4M-rating
+  numpy run, flagged in its JSON.
+* MFU: an analytic FLOP model of the ALS sweep (gram nnz*K^2 + solve
+  segs*K^3 MACs) against the chip's bf16 peak — an estimate (the math
+  runs in f32), reported per config next to wall-clock.
+
+Configs:
+  pipeline_ml100k   the judged path: 100k rate events -> sqlite event
+                    store -> run_train workflow (`pio train` wall-clock)
+                    -> deploy -> 1k HTTP /queries.json, p50/p99
+  als_ml100k        recommendation ALS kernel @ MovieLens-100K shape
+  cooccurrence_ml1m similarproduct cooccurrence @ ML-1M shape
+  naive_bayes_spam  classification NB, spam/ham scale
+  ecommerce_implicit_als  implicit ALS (view+buy confidence) + top-N
+  eval_sweep_3fold_3rank  cross-validated ALS hyperparameter sweep
+  als_ml20m         MovieLens-20M-shape ALS on one chip: 20M ratings,
+                    138k x 27k, string-id assignment + data build +
+                    train + RMSE all timed (north star, BASELINE.md)
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -31,6 +52,10 @@ RANK, ITERS, REG = 10, 20, 0.01
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
+
+# ---------------------------------------------------------------------------
+# Synthetic data + measured numpy baselines (no jax)
+# ---------------------------------------------------------------------------
 
 def synthetic_ratings(n_users, n_items, nnz, seed=0, implicit=False):
     rng = np.random.default_rng(seed)
@@ -46,36 +71,226 @@ def synthetic_ratings(n_users, n_items, nnz, seed=0, implicit=False):
     return users, items, ratings
 
 
-def numpy_als_sweep_time(users, items, ratings, n_users, n_items,
-                         rank) -> float:
-    """One user-side half-sweep in vectorized numpy (the CPU baseline)."""
-    rng = np.random.default_rng(1)
-    V = rng.normal(size=(n_items, rank)).astype(np.float32) / np.sqrt(rank)
-    order = np.argsort(users, kind="stable")
-    u_s, i_s, r_s = users[order], items[order], ratings[order]
-    t0 = time.perf_counter()
-    f = V[i_s]                                        # [nnz, K]
-    outer = np.einsum("nk,nl->nkl", f, f)             # [nnz, K, K]
-    gram = np.zeros((n_users, rank, rank), np.float32)
-    np.add.at(gram, u_s, outer)
-    rhs = np.zeros((n_users, rank), np.float32)
-    np.add.at(rhs, u_s, f * r_s[:, None])
-    cnt = np.bincount(u_s, minlength=n_users).astype(np.float32)
-    A = gram + (REG * np.maximum(cnt, 1.0))[:, None, None] * \
+def _np_half_sweep(F, seg, tgt, val, n_seg, rank, reg, implicit=False,
+                   alpha=1.0, chunk=1_000_000):
+    """One numpy half-sweep (same math as the device kernel), chunked so
+    the [n, K, K] outer-product buffer stays bounded at 20M nnz."""
+    gram = np.zeros((n_seg, rank, rank), np.float32)
+    rhs = np.zeros((n_seg, rank), np.float32)
+    cnt = np.zeros(n_seg, np.float32)
+    for lo in range(0, len(seg), chunk):
+        s, t, v = seg[lo:lo + chunk], tgt[lo:lo + chunk], val[lo:lo + chunk]
+        f = F[t]
+        if implicit:
+            w = alpha * np.abs(v)                     # c - 1
+            p = (v > 0).astype(np.float32)
+            outer = np.einsum("nk,nl->nkl", f, f) * w[:, None, None]
+            np.add.at(gram, s, outer)
+            np.add.at(rhs, s, f * ((1.0 + w) * p)[:, None])
+            np.add.at(cnt, s, w)
+        else:
+            outer = np.einsum("nk,nl->nkl", f, f)
+            np.add.at(gram, s, outer)
+            np.add.at(rhs, s, f * v[:, None])
+            np.add.at(cnt, s, 1.0)
+    if implicit:
+        gram = gram + (F.T @ F)[None, :, :]
+    A = gram + (reg * np.maximum(cnt, 1.0))[:, None, None] * \
         np.eye(rank, dtype=np.float32)
-    np.linalg.solve(A, rhs[..., None])
-    return time.perf_counter() - t0
+    return np.linalg.solve(A, rhs[..., None])[..., 0]
 
 
-def bench_als(mesh) -> tuple:
-    """Config 1: recommendation ALS @ ML-100K shape."""
+def numpy_als_baseline(users, items, ratings, nu, ni, rank, iters, reg=REG,
+                       implicit=False, alpha=1.0, measure_iters=None,
+                       seed=1):
+    """MEASURED full numpy ALS run (both sides per iteration). When
+    `measure_iters` < iters, the measured iterations are extrapolated
+    linearly (flagged by the caller in its JSON)."""
+    rng = np.random.default_rng(seed)
+    V = rng.normal(size=(ni, rank)).astype(np.float32) / np.sqrt(rank)
+    run = min(measure_iters or iters, iters)
+    t0 = time.perf_counter()
+    for _ in range(run):
+        U = _np_half_sweep(V, users, items, ratings, nu, rank, reg,
+                           implicit, alpha)
+        V = _np_half_sweep(U, items, users, ratings, ni, rank, reg,
+                           implicit, alpha)
+    dt = time.perf_counter() - t0
+    return dt * (iters / run), run
+
+
+# ---------------------------------------------------------------------------
+# FLOP model / MFU
+# ---------------------------------------------------------------------------
+
+def als_model_flops(nnz, nu, ni, rank, iters):
+    """Analytic FLOPs of `iters` full ALS iterations: Gramian assembly
+    (one K x K outer-accumulate per rating, both sides) + rhs + batched
+    Cholesky solves (K^3/3 factor + 2 K^2 triangular solves/segment)."""
+    gram = 2 * nnz * rank * rank * 2          # both sides, 2 flops/MAC
+    rhs = 2 * nnz * rank * 2
+    solve = (nu + ni) * (rank ** 3 / 3 + 2 * rank * rank) * 2
+    return iters * (gram + rhs + solve)
+
+
+_PEAK_BF16 = (  # (device_kind substring, peak bf16 FLOP/s per chip)
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+)
+
+
+def peak_flops(device_kind: str):
+    kind = (device_kind or "").lower()
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None     # unknown chip / CPU: no MFU claim
+
+
+# ---------------------------------------------------------------------------
+# Worker-side backend setup
+# ---------------------------------------------------------------------------
+
+def setup_backend(platform: str):
+    """Import jax pinned to `platform`. jax.config is authoritative —
+    device plugins (the tunneled TPU) override JAX_PLATFORMS alone and
+    can hang the process when the remote chip is unreachable."""
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    devices = jax.devices()
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devices)[:1], axis_names=("data",))
+    return jax, devices, mesh
+
+
+# ---------------------------------------------------------------------------
+# Configs — each returns a detail dict
+# ---------------------------------------------------------------------------
+
+def cfg_pipeline_ml100k(jax, mesh, platform):
+    """The judged workload boundary (BASELINE.md target metrics): events
+    in the store -> `pio train` equivalent -> deploy -> HTTP query
+    latency. Mirrors the reference quickstart
+    (tests/pio_tests/scenarios/quickstart_test.py:33-95,
+    CreateServer.scala:597-604)."""
+    import asyncio
+    import tempfile
+
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.engines.recommendation import (
+        default_engine_params, engine as engine_factory)
+    from predictionio_tpu.storage import App, Storage
+    from predictionio_tpu.workflow import run_train
+    from predictionio_tpu.workflow.train import load_for_deploy
+
+    nu, ni, nnz = 943, 1682, 100_000
+    users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=11)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        Storage.configure({
+            "sources": {"DB": {"TYPE": "sqlite",
+                               "PATH": os.path.join(tmp, "bench.db")}},
+            "repositories": {
+                "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+                "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+                "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+            },
+        })
+        from predictionio_tpu.data.eventstore import clear_cache
+        clear_cache()
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="BenchApp"))
+        store = Storage.get_events()
+        store.init_channel(app_id)
+
+        t0 = time.perf_counter()
+        batch = []
+        for u, i, r in zip(users, items, ratings):
+            batch.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(r)})))
+            if len(batch) >= 10_000:
+                store.insert_batch(batch, app_id)
+                batch = []
+        if batch:
+            store.insert_batch(batch, app_id)
+        import_s = time.perf_counter() - t0
+
+        engine = engine_factory()
+        ep = default_engine_params("BenchApp", rank=RANK,
+                                   num_iterations=ITERS)
+        t0 = time.perf_counter()
+        instance = run_train(
+            engine, ep,
+            engine_factory="predictionio_tpu.engines.recommendation:engine")
+        train_s = time.perf_counter() - t0   # the `pio train` wall-clock
+
+        t0 = time.perf_counter()
+        result, ctx = load_for_deploy(engine, instance)
+        deploy_s = time.perf_counter() - t0
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from predictionio_tpu.server.query_server import create_query_server
+
+        server = create_query_server(engine, result, instance, ctx)
+        lat = []
+
+        async def drive():
+            c = TestClient(TestServer(server.app))
+            await c.start_server()
+            try:
+                for q in range(20):        # warm-up (compile + caches)
+                    await c.post("/queries.json",
+                                 json={"user": f"u{q % nu}", "num": 10})
+                for q in range(1000):
+                    t = time.perf_counter()
+                    resp = await c.post(
+                        "/queries.json",
+                        json={"user": f"u{q % nu}", "num": 10})
+                    assert resp.status == 200, await resp.text()
+                    body = await resp.json()
+                    assert len(body["itemScores"]) == 10
+                    lat.append(time.perf_counter() - t)
+            finally:
+                await c.close()
+
+        asyncio.run(drive())
+        Storage.reset()
+        clear_cache()
+
+    lat_ms = np.asarray(lat) * 1e3
+    p50, p99 = float(np.percentile(lat_ms, 50)), float(np.percentile(lat_ms, 99))
+    return {
+        "elapsed_s": round(train_s, 3),
+        "baseline_s": None,
+        "note": (f"import {import_s:.1f}s, pio-train {train_s:.2f}s, "
+                 f"deploy {deploy_s:.2f}s, query p50 {p50:.2f}ms "
+                 f"p99 {p99:.2f}ms over 1000 HTTP queries"),
+        "import_s": round(import_s, 2),
+        "train_s": round(train_s, 3),
+        "deploy_s": round(deploy_s, 3),
+        "query_p50_ms": round(p50, 3),
+        "query_p99_ms": round(p99, 3),
+    }
+
+
+def cfg_als_ml100k(jax, mesh, platform):
+    """Config 1 kernel: recommendation ALS @ ML-100K shape; measured
+    numpy baseline is a FULL run of the same math (not extrapolated)."""
     from predictionio_tpu.models.als import ALSData, ALSParams, train_als
     from predictionio_tpu.models.als import rmse as als_rmse
 
     nu, ni, nnz = 943, 1682, 100_000
     users, items, ratings = synthetic_ratings(nu, ni, nnz)
-    base = numpy_als_sweep_time(users, items, ratings, nu, ni, RANK) \
-        * 2 * ITERS
+    base, measured = numpy_als_baseline(users, items, ratings, nu, ni,
+                                        RANK, ITERS)
     params = ALSParams(rank=RANK, num_iterations=ITERS, reg=REG,
                        chunk_size=16384)
     data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
@@ -86,12 +301,75 @@ def bench_als(mesh) -> tuple:
     elapsed = time.perf_counter() - t0
     err = als_rmse(U, V, users, items, ratings)
     assert np.isfinite(err), "ALS diverged"
-    return elapsed, base, f"train-RMSE {err:.3f}"
+    flops = als_model_flops(nnz, nu, ni, RANK, ITERS)
+    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
+            "baseline_measured_iters": measured,
+            "model_flops": flops,
+            "note": f"train-RMSE {err:.3f}"}
 
 
-def bench_cooccurrence(mesh) -> tuple:
+def cfg_als_ml20m(jax, mesh, platform):
+    """North-star shape (BASELINE.md): 20M ratings, 138k users x 27k
+    items, trained end-to-end on one chip — string-id assignment, data
+    build, train, RMSE all timed. On the CPU fallback the shape scales
+    down (flagged) so partial results still arrive."""
+    from predictionio_tpu.data.bimap import assign_indices
+    from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+    from predictionio_tpu.models.als import rmse as als_rmse
+
+    if platform == "cpu":
+        nu, ni, nnz, iters, scaled = 30_000, 10_000, 2_000_000, 5, True
+    else:
+        nu, ni, nnz, iters, scaled = 138_000, 27_000, 20_000_000, ITERS, False
+    users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=20)
+
+    # the BiMap.scala:126-128 hard part: string ids -> contiguous indices
+    user_ids = users.astype("U8")
+    item_ids = items.astype("U8")
+    t0 = time.perf_counter()
+    user_vocab, user_codes = assign_indices(user_ids)
+    item_vocab, item_codes = assign_indices(item_ids)
+    id_assign_s = time.perf_counter() - t0
+    del user_ids, item_ids
+    nu_r, ni_r = len(user_vocab), len(item_vocab)
+
+    t0 = time.perf_counter()
+    data = ALSData.build(user_codes, item_codes, ratings, nu_r, ni_r,
+                         n_shards=1)
+    build_s = time.perf_counter() - t0
+
+    params = ALSParams(rank=RANK, num_iterations=iters, reg=REG,
+                       chunk_size=16384)
+    train_als(mesh, data, params)               # warm-up compile
+    t0 = time.perf_counter()
+    U, V = train_als(mesh, data, params)
+    train_s = time.perf_counter() - t0
+    err = als_rmse(U, V, user_codes[:1_000_000], item_codes[:1_000_000],
+                   ratings[:1_000_000])
+    assert np.isfinite(err), "ALS diverged"
+
+    # numpy baseline measured on a >=4M-rating run, extrapolated linearly
+    cap = min(nnz, 4_000_000)
+    bi = max(1, min(2, iters))
+    base_cap, measured = numpy_als_baseline(
+        user_codes[:cap], item_codes[:cap], ratings[:cap], nu_r, ni_r,
+        RANK, iters, measure_iters=bi)
+    base = base_cap * (nnz / cap)
+    flops = als_model_flops(nnz, nu_r, ni_r, RANK, iters)
+    return {"elapsed_s": round(train_s, 3), "baseline_s": round(base, 2),
+            "baseline_measured_iters": measured,
+            "baseline_extrapolated_from_nnz": cap,
+            "model_flops": flops, "scaled_for_cpu": scaled,
+            "nnz": nnz,
+            "note": (f"{nnz / 1e6:.0f}M ratings {nu_r}x{ni_r}: id-assign "
+                     f"{id_assign_s:.1f}s, build {build_s:.1f}s, train "
+                     f"{train_s:.2f}s ({iters} iters), RMSE {err:.3f}"),
+            "id_assign_s": round(id_assign_s, 2),
+            "build_s": round(build_s, 2)}
+
+
+def cfg_cooccurrence(jax, mesh, platform):
     """Config 2: similarproduct cooccurrence @ ML-1M shape."""
-    import jax
     import jax.numpy as jnp
 
     from predictionio_tpu.models.cooccurrence import distinct_pairs
@@ -122,10 +400,14 @@ def bench_cooccurrence(mesh) -> tuple:
     scores, idx = count_topn(jnp.asarray(users), jnp.asarray(items))
     jax.block_until_ready((scores, idx))
     elapsed = time.perf_counter() - t0
-    return elapsed, base, f"{len(users)} distinct pairs"
+    # matmul-dominated: A^T A is 2 * nu * ni^2 flops
+    flops = 2.0 * nu * ni * ni
+    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
+            "model_flops": flops,
+            "note": f"{len(users)} distinct pairs"}
 
 
-def bench_naive_bayes(mesh) -> tuple:
+def cfg_naive_bayes(jax, mesh, platform):
     """Config 3: classification NaiveBayes, spam/ham-scale."""
     from predictionio_tpu.models.naive_bayes import train_multinomial_nb
 
@@ -154,12 +436,13 @@ def bench_naive_bayes(mesh) -> tuple:
     elapsed = time.perf_counter() - t0
     acc = float((pred == labels).mean())
     assert acc > 0.9, f"NB accuracy {acc}"
-    return elapsed, base, f"accuracy {acc:.3f}"
+    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
+            "note": f"accuracy {acc:.3f}"}
 
 
-def bench_ecommerce(mesh) -> tuple:
-    """Config 4: ecommerce implicit ALS (view+buy confidence) + top-N."""
-    import jax
+def cfg_ecommerce(jax, mesh, platform):
+    """Config 4: ecommerce implicit ALS (view+buy confidence) + top-N;
+    measured numpy baseline runs the same implicit math in full."""
     import jax.numpy as jnp
 
     from predictionio_tpu.models.als import ALSData, ALSParams, train_als
@@ -168,8 +451,8 @@ def bench_ecommerce(mesh) -> tuple:
     users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=4,
                                               implicit=True)
     iters = 10
-    base = numpy_als_sweep_time(users, items, ratings, nu, ni, RANK) \
-        * 2 * iters
+    base, measured = numpy_als_baseline(users, items, ratings, nu, ni,
+                                        RANK, iters, implicit=True)
     params = ALSParams(rank=RANK, num_iterations=iters, reg=REG,
                        implicit_prefs=True, alpha=1.0, chunk_size=16384)
 
@@ -179,18 +462,22 @@ def bench_ecommerce(mesh) -> tuple:
 
     data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
     U, V = train_als(mesh, data, params)   # warm-up train ...
-    jax.block_until_ready(topn(jnp.asarray(U), jnp.asarray(V)))  # ... and topn
+    jax.block_until_ready(topn(jnp.asarray(U), jnp.asarray(V)))
     t0 = time.perf_counter()
     data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
     U, V = train_als(mesh, data, params)
     scores, idx = topn(jnp.asarray(U), jnp.asarray(V))
     jax.block_until_ready((scores, idx))
     elapsed = time.perf_counter() - t0
-    return elapsed, base, "implicit ALS + batch top-10"
+    flops = als_model_flops(nnz, nu, ni, RANK, iters)
+    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
+            "baseline_measured_iters": measured, "model_flops": flops,
+            "note": "implicit ALS + batch top-10"}
 
 
-def bench_eval_sweep(mesh) -> tuple:
-    """Config 5: 3-fold x 3-rank cross-validated ALS sweep."""
+def cfg_eval_sweep(jax, mesh, platform):
+    """Config 5: 3-fold x 3-rank cross-validated ALS sweep; the numpy
+    baseline runs the IDENTICAL sweep in full."""
     from predictionio_tpu.models.als import ALSData, ALSParams, train_als
     from predictionio_tpu.models.als import rmse as als_rmse
 
@@ -199,14 +486,13 @@ def bench_eval_sweep(mesh) -> tuple:
     k_fold, ranks, iters = 3, (8, 10, 12), 5
     fold_of = np.arange(nnz) % k_fold
 
-    # baseline: one measured numpy half-sweep per rank, extrapolated over
-    # folds x iterations x 2 sides (same math as the device path)
-    base = 0.0
+    t0 = time.perf_counter()
     for rank in ranks:
-        tr = fold_of != 0
-        base += numpy_als_sweep_time(
-            users[tr], items[tr], ratings[tr], nu, ni, rank) \
-            * 2 * iters * k_fold
+        for f in range(k_fold):
+            tr = fold_of != f
+            numpy_als_baseline(users[tr], items[tr], ratings[tr], nu, ni,
+                               rank, iters)
+    base = time.perf_counter() - t0
 
     def sweep():
         best = (None, np.inf)
@@ -231,37 +517,212 @@ def bench_eval_sweep(mesh) -> tuple:
     t0 = time.perf_counter()
     best_rank, best_err = sweep()
     elapsed = time.perf_counter() - t0
-    return elapsed, base, f"best rank {best_rank}, test-RMSE {best_err:.3f}"
+    flops = sum(als_model_flops(nnz * (k_fold - 1) // k_fold, nu, ni, r,
+                                iters) * k_fold for r in ranks)
+    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
+            "model_flops": flops,
+            "note": f"best rank {best_rank}, test-RMSE {best_err:.3f}"}
+
+
+CONFIGS = {
+    "pipeline_ml100k": (cfg_pipeline_ml100k, 1200),
+    "als_ml100k": (cfg_als_ml100k, 900),
+    "cooccurrence_ml1m": (cfg_cooccurrence, 600),
+    "naive_bayes_spam": (cfg_naive_bayes, 600),
+    "ecommerce_implicit_als": (cfg_ecommerce, 900),
+    "eval_sweep_3fold_3rank": (cfg_eval_sweep, 1200),
+    "als_ml20m": (cfg_als_ml20m, 2700),
+}
+
+
+# ---------------------------------------------------------------------------
+# Worker entry points
+# ---------------------------------------------------------------------------
+
+def worker_probe(platform: str) -> None:
+    jax, devices, _mesh = setup_backend(platform)
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256))
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    print(json.dumps({"ok": True, "platform": platform,
+                      "n_devices": len(devices),
+                      "device_kind": devices[0].device_kind}), flush=True)
+
+
+def worker_config(name: str, platform: str) -> None:
+    fn, _budget = CONFIGS[name]
+    jax, devices, mesh = setup_backend(platform)
+    t0 = time.perf_counter()
+    detail = fn(jax, mesh, platform)
+    detail.update({
+        "name": name, "platform": platform,
+        "device_kind": devices[0].device_kind,
+        "total_s": round(time.perf_counter() - t0, 2),
+    })
+    base, elapsed = detail.get("baseline_s"), detail.get("elapsed_s")
+    if base and elapsed:
+        detail["speedup"] = round(base / elapsed, 2)
+    peak = peak_flops(devices[0].device_kind)
+    if peak and detail.get("model_flops") and elapsed:
+        detail["mfu"] = round(detail["model_flops"] / elapsed / peak, 5)
+    detail.pop("model_flops", None)
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator (no jax in this process)
+# ---------------------------------------------------------------------------
+
+def _last_json(out: str):
+    """Parse the last JSON-looking line of worker stdout; None on any
+    malformed/truncated output (a killed worker must never crash the
+    orchestrator's collection loop)."""
+    for line in reversed((out or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def _run_sub(args, timeout):
+    """Run a worker subprocess; (rc, stdout, stderr_tail). rc=124 on
+    timeout — the subprocess is killed, the suite lives on."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            capture_output=True, text=True, timeout=timeout)
+        return p.returncode, p.stdout, p.stderr[-2000:]
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return 124, out, f"timeout after {timeout}s"
+
+
+def resolve_platform():
+    """BENCH_PLATFORM override, else probe the env-configured platform
+    (the real chip) with retries + backoff, else CPU."""
+    override = os.environ.get("BENCH_PLATFORM")
+    if override:
+        log(f"[bench] platform forced to {override} via BENCH_PLATFORM")
+        rc, out, err = _run_sub(["--probe", override], timeout=420)
+        if rc == 0:
+            return override, _last_json(out)
+        log(f"[bench] forced platform {override} probe FAILED (rc={rc}) — "
+            "falling back to CPU")
+        return "cpu", None
+
+    plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() or "tpu"
+    plat = None if plat == "cpu" else plat
+
+    if plat:
+        for attempt, budget in enumerate((240, 240, 360)):
+            rc, out, err = _run_sub(["--probe", plat], timeout=budget)
+            info = _last_json(out) if rc == 0 else None
+            if info:
+                log(f"[bench] platform {plat} up: "
+                    f"{info['n_devices']} x {info['device_kind']}")
+                return plat, info
+            log(f"[bench] probe {plat} attempt {attempt + 1} failed "
+                f"(rc={rc}): {err.strip().splitlines()[-1] if err.strip() else 'no output'}")
+            time.sleep(10 * (attempt + 1))
+    log("[bench] no accelerator reachable — falling back to CPU")
+    rc, out, err = _run_sub(["--probe", "cpu"], timeout=240)
+    return "cpu", (_last_json(out) if rc == 0 else None)
 
 
 def main():
-    import jax
-    from jax.sharding import Mesh
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe")
+    ap.add_argument("--config")
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--only", help="comma-separated config subset")
+    args = ap.parse_args()
 
-    devices = np.asarray(jax.devices())
-    mesh = Mesh(devices.reshape(-1)[:1], axis_names=("data",))
+    if args.probe:
+        worker_probe(args.probe)
+        return
+    if args.config:
+        worker_config(args.config, args.platform)
+        return
 
-    configs = [
-        ("als_ml100k", bench_als),
-        ("cooccurrence_ml1m", bench_cooccurrence),
-        ("naive_bayes_spam", bench_naive_bayes),
-        ("ecommerce_implicit_als", bench_ecommerce),
-        ("eval_sweep_3fold_3rank", bench_eval_sweep),
-    ]
-    total, speedups = 0.0, []
-    for name, fn in configs:
-        elapsed, base, note = fn(mesh)
-        total += elapsed
-        speedups.append(base / elapsed)
-        log(f"[bench] {name}: tpu {elapsed:.3f}s, numpy {base:.3f}s, "
-            f"speedup {base / elapsed:.1f}x ({note})")
+    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_S",
+                                                       5400))
+    platform, _info = resolve_platform()
 
-    geomean = float(np.exp(np.mean(np.log(speedups))))
+    names = list(CONFIGS)
+    if args.only:
+        names = args.only.split(",")
+        unknown = [n for n in names if n not in CONFIGS]
+        if unknown:
+            log(f"[bench] unknown config(s) {unknown}; "
+                f"known: {list(CONFIGS)}")
+            sys.exit(2)
+
+    details, failures = [], []
+    for name in names:
+        _fn, budget = CONFIGS[name]
+        remain = deadline - time.monotonic()
+        if remain < 60:
+            failures.append({"name": name, "error": "suite deadline hit"})
+            log(f"[bench] {name}: SKIPPED (deadline)")
+            continue
+        rc, out, err = _run_sub(
+            ["--config", name, "--platform", platform],
+            timeout=min(budget, remain))
+        detail = None
+        for line in out.splitlines():
+            if line.startswith("BENCH_DETAIL "):
+                try:
+                    detail = json.loads(line[len("BENCH_DETAIL "):])
+                except json.JSONDecodeError:
+                    pass          # truncated line from a killed worker
+        if rc == 0 and detail:
+            details.append(detail)
+            log(f"[bench] {name}: {json.dumps(detail)}")
+        else:
+            tail = (err or out).strip().splitlines()
+            failures.append({"name": name, "rc": rc,
+                             "error": tail[-1] if tail else "no output"})
+            log(f"[bench] {name}: FAILED rc={rc} "
+                f"({tail[-1] if tail else 'no output'})")
+
+    total = sum(d.get("elapsed_s") or 0.0 for d in details)
+    speedups = [d["speedup"] for d in details if d.get("speedup")]
+    geomean = (float(np.exp(np.mean(np.log(speedups))))
+               if speedups else 0.0)
+    mfus = {d["name"]: d["mfu"] for d in details if d.get("mfu")}
+    pipeline = next((d for d in details if d["name"] == "pipeline_ml100k"),
+                    None)
+
+    per_cfg = ", ".join(
+        f"{d['name']} {d.get('speedup', '-')}x"
+        + (f"/mfu {d['mfu']:.1%}" if d.get("mfu") else "")
+        for d in details)
+    unit = (f"seconds total across {len(details)}/{len(names)} configs on "
+            f"{platform}; speedups [{per_cfg}]")
+    if pipeline:
+        unit += (f"; pio-train {pipeline['train_s']}s, query p50 "
+                 f"{pipeline['query_p50_ms']}ms p99 "
+                 f"{pipeline['query_p99_ms']}ms")
+
+    # full per-config artifact for the judge
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAILS.json"), "w") as f:
+            json.dump({"platform": platform, "details": details,
+                       "failures": failures, "mfu": mfus}, f, indent=1)
+    except OSError:
+        pass
+
     print(json.dumps({
-        "metric": "judged_suite_5config_wallclock",
-        "value": round(total, 4),
-        "unit": f"seconds total on {devices.size} device(s); per-config "
-                f"speedups {[round(s, 1) for s in speedups]}",
+        "metric": "judged_suite_wallclock",
+        "value": round(total, 3),
+        "unit": unit,
         "vs_baseline": round(geomean, 2),
     }))
 

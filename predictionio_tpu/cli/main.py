@@ -632,6 +632,7 @@ def template_list():
         "classification": "predictionio_tpu.engines.classification:engine",
         "ecommerce": "predictionio_tpu.engines.ecommerce:engine",
         "sessionrec": "predictionio_tpu.engines.sessionrec:engine",
+        "recommendeduser": "predictionio_tpu.engines.recommended_user:engine",
     }
     for name, factory in templates.items():
         click.echo(f"[INFO] {name:<16} {factory}")
@@ -667,6 +668,11 @@ def template_get(name, directory):
                          "params": {"d_model": 64, "n_heads": 2,
                                     "n_layers": 2, "max_len": 32,
                                     "epochs": 10}}]),
+        "recommendeduser": (
+            "predictionio_tpu.engines.recommended_user:engine",
+            {"app_name": "MyApp"},
+            [{"name": "als",
+              "params": {"rank": 10, "num_iterations": 20}}]),
     }
     if name not in factories:
         click.echo(f"[ERROR] Unknown template {name}. "

@@ -5,7 +5,10 @@ Rebuilds the reference's judged example templates (SURVEY.md section 2.8):
   * similarproduct    <- examples/scala-parallel-similarproduct (ALS implicit
                          + cooccurrence)
   * classification    <- examples/scala-parallel-classification (NaiveBayes,
-                         LogisticRegression)
+                         LogisticRegression, RandomForest)
+  * recommended_user  <- examples/scala-parallel-similarproduct/
+                         recommended-user (user-to-user similarity over
+                         follow events)
   * ecommerce         <- examples/scala-parallel-ecommercerecommendation
                          (ALS + business-rule filters)
 

@@ -27,6 +27,7 @@ from predictionio_tpu.core import (
 )
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.eventstore import EventStoreClient
+from predictionio_tpu.models.forest import ForestModel, ForestParams, train_forest
 from predictionio_tpu.models.logreg import LogRegModel, LogRegParams, train_logreg
 from predictionio_tpu.models.naive_bayes import MultinomialNBModel, train_multinomial_nb
 
@@ -190,6 +191,35 @@ class LogisticRegressionAlgorithm(Algorithm):
         return _vector_batch_predict(model, queries)
 
 
+#: RandomForestAlgorithmParams parity (add-algorithm/src/main/scala/
+#: RandomForestAlgorithm.scala: numClasses, numTrees,
+#: featureSubsetStrategy, impurity, maxDepth, maxBins)
+RandomForestParams = ForestParams
+
+
+class RandomForestAlgorithm(Algorithm):
+    """RandomForestAlgorithm.scala parity on the vmapped histogram-split
+    forest (models/forest.py)."""
+
+    params_class = ForestParams
+
+    def __init__(self, params: Optional[ForestParams] = None):
+        self.params = params or ForestParams()
+
+    def train(self, ctx, pd: PreparedData) -> ForestModel:
+        if not pd.points:
+            raise ValueError("no labeled points; import training data first")
+        X, y = _xy(pd)
+        return train_forest(X, y, self.params)
+
+    def predict(self, model: ForestModel, query: Query) -> PredictedResult:
+        x = np.asarray([[query.attr0, query.attr1, query.attr2]], np.float32)
+        return PredictedResult(label=float(model.predict(x)[0]))
+
+    def batch_predict(self, model, queries):
+        return _vector_batch_predict(model, queries)
+
+
 class ClassificationServing(FirstServing):
     pass
 
@@ -207,7 +237,8 @@ def engine() -> Engine:
         data_source_classes=ClassificationDataSource,
         preparator_classes=ClassificationPreparator,
         algorithm_classes={"naive": NaiveBayesAlgorithm,
-                           "logreg": LogisticRegressionAlgorithm},
+                           "logreg": LogisticRegressionAlgorithm,
+                           "randomforest": RandomForestAlgorithm},
         serving_classes=ClassificationServing,
     )
 
@@ -215,7 +246,8 @@ def engine() -> Engine:
 def default_engine_params(app_name: str, algorithm: str = "naive",
                           eval_k: Optional[int] = None) -> EngineParams:
     defaults = {"naive": NaiveBayesParams(),
-                "logreg": LogisticRegressionParams()}
+                "logreg": LogisticRegressionParams(),
+                "randomforest": ForestParams()}
     return EngineParams(
         data_source_params=DataSourceParams(app_name=app_name, eval_k=eval_k),
         algorithm_params_list=[(algorithm, defaults[algorithm])],

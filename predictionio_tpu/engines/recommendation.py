@@ -55,8 +55,15 @@ class PreparedData:
 
 @dataclasses.dataclass(frozen=True)
 class Query:
+    """Quickstart query plus the blacklist-items variant's filters
+    (examples/scala-parallel-recommendation/blacklist-items Query:
+    user, num, blackList — whiteList is the natural dual, wired to the
+    same model mask). JSON keys: "blackList" / "whiteList"."""
+
     user: str
     num: int
+    black_list: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
 
 
 @dataclasses.dataclass
@@ -83,30 +90,47 @@ class ActualResult:
 
 @dataclasses.dataclass
 class DataSourceParams(Params):
+    """Default = the customize-serving variant (rate + buy). The
+    train-with-view-event variant is a config, not a fork: set
+    eventNames=["view"] (+ implicitPrefs on the algorithm) and each view
+    contributes eventWeights["view"] to the (user, item) preference —
+    examples/scala-parallel-recommendation/train-with-view-event/
+    DataSource.scala reads "view" events into implicit 1.0 ratings."""
+
     app_name: str
     eval_params: Optional[dict] = None  # {"kFold": 5, "queryNum": 10}
+    #: which events become ratings; None = ["rate", "buy"]
+    event_names: Optional[List[str]] = None
+    #: rating assigned per non-"rate" event (the "rate" event always
+    #: reads its rating property); None = {"buy": 4.0, "view": 1.0}
+    event_weights: Optional[dict] = None
 
 
 class RecommendationDataSource(DataSource):
     """DataSource.scala:39 — rate events keep their rating property; buy
-    events become implicit rating 4.0 (:61-73)."""
+    events become implicit rating 4.0 (:61-73); view events (variant)
+    weight 1.0 each."""
 
     params_class = DataSourceParams
-    BUY_RATING = 4.0
+    DEFAULT_WEIGHTS = {"buy": 4.0, "view": 1.0}
 
     def __init__(self, params: DataSourceParams):
         self.params = params
 
     def _read_ratings(self) -> List[Rating]:
+        names = self.params.event_names or ["rate", "buy"]
+        weights = {**self.DEFAULT_WEIGHTS, **(self.params.event_weights or {})}
         events = EventStoreClient.find(
             app_name=self.params.app_name,
             entity_type="user",
-            event_names=["rate", "buy"],
+            event_names=names,
             target_entity_type="item")
         ratings = []
         for e in events:
-            rating = (self.BUY_RATING if e.event == "buy"
-                      else float(e.properties.get("rating")))
+            if e.event == "rate":
+                rating = float(e.properties.get("rating"))
+            else:
+                rating = float(weights.get(e.event, 1.0))
             ratings.append(Rating(user=e.entity_id,
                                   item=e.target_entity_id,
                                   rating=rating))
@@ -190,14 +214,20 @@ class ALSAlgorithm(Algorithm):
         return ALSModel(user_vocab=user_vocab, item_vocab=item_vocab, U=U, V=V)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
-        recs = model.recommend(query.user, query.num)
+        recs = model.recommend(
+            query.user, query.num,
+            exclude_items=tuple(query.black_list or ()),
+            allow_items=(tuple(query.white_list)
+                         if query.white_list is not None else None))
         return PredictedResult(
             item_scores=[ItemScore(item=i, score=s) for i, s in recs])
 
     def batch_predict(self, model: ALSModel, queries):
         """Vectorized: one device matmul for the whole batch — the eval /
         micro-batch fast path (vs CreateServer.scala:508 serial loop)."""
-        reqs = [(q.user, q.num, (), None) for _, q in queries]
+        reqs = [(q.user, q.num, tuple(q.black_list or ()),
+                 tuple(q.white_list) if q.white_list is not None else None)
+                for _, q in queries]
         recs = model.recommend_batch(reqs)
         return [
             (i, PredictedResult(item_scores=[

@@ -279,3 +279,147 @@ def test_ecommerce_unseen_only_and_unavailable(backend, ecomm_app):
         app_id, entity_type="user", entity_id="u0",
         event_names=["view", "buy"])}
     assert not (set(items) & seen)
+
+
+def test_classification_random_forest(classification_app):
+    """RandomForest variant parity (add-algorithm template): a tree
+    ensemble learns the attr0>attr1 rule and serves it."""
+    from predictionio_tpu.engines.classification import (
+        Query, default_engine_params, engine,
+    )
+
+    eng = engine()
+    ep = default_engine_params(classification_app, algorithm="randomforest")
+    instance = run_train(
+        eng, ep,
+        engine_factory="predictionio_tpu.engines.classification:engine")
+    result, _ctx = load_for_deploy(eng, instance)
+    algo, model = result.algorithms[0], result.models[0]
+    assert algo.predict(model, Query(attr0=7.0, attr1=0.0, attr2=1.0)).label == 1.0
+    assert algo.predict(model, Query(attr0=0.0, attr1=7.0, attr2=1.0)).label == 0.0
+    # batch path agrees with serial
+    qs = [Query(attr0=float(a), attr1=float(b), attr2=1.0)
+          for a in (0, 3, 7) for b in (0, 3, 7)]
+    serial = [algo.predict(model, q).label for q in qs]
+    batched = dict(algo.batch_predict(model, list(enumerate(qs))))
+    assert [batched[i].label for i in range(len(qs))] == serial
+
+
+def test_random_forest_beats_linear_on_xor():
+    """The forest exists to cover the nonlinear case the template's other
+    algorithms can't: XOR labels, where logreg is at chance."""
+    from predictionio_tpu.models.forest import ForestParams, train_forest
+    from predictionio_tpu.models.logreg import LogRegParams, train_logreg
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3000, 3)).astype(np.float32)
+    y = np.where((X[:, 0] > 0) ^ (X[:, 1] > 0), "a", "b")
+    forest = train_forest(X[:2000], y[:2000],
+                          ForestParams(num_trees=10, max_depth=5))
+    f_acc = (forest.predict(X[2000:]) == y[2000:]).mean()
+    lr = train_logreg(X[:2000], list(y[:2000]), LogRegParams())
+    l_acc = (lr.predict(X[2000:]) == y[2000:]).mean()
+    assert f_acc > 0.9, f_acc
+    assert l_acc < 0.65, l_acc          # linear model is ~chance here
+
+
+def test_random_forest_param_surface():
+    """featureSubsetStrategy / impurity / maxBins accept the reference's
+    values (RandomForestAlgorithm.scala params)."""
+    from predictionio_tpu.core.params import params_from_json
+    from predictionio_tpu.models.forest import ForestParams, train_forest
+
+    p = params_from_json(
+        {"numClasses": 2, "numTrees": 5, "featureSubsetStrategy": "sqrt",
+         "impurity": "entropy", "maxDepth": 3, "maxBins": 16}, ForestParams)
+    assert (p.num_trees, p.impurity, p.max_bins) == (5, "entropy", 16)
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(400, 4)).astype(np.float32)
+    y = np.where(X[:, 0] + X[:, 2] > 0, 1.0, 0.0)
+    m = train_forest(X, y, p)
+    assert (m.predict(X) == y).mean() > 0.85
+
+
+# -- recommended-user (similarproduct variant) -------------------------------
+
+@pytest.fixture()
+def follow_app(backend):
+    app_id = make_app(backend, "FollowApp")
+    store = backend.get_events()
+    events = [Event(event="$set", entity_type="user", entity_id=f"u{u}")
+              for u in range(24)]
+    rng = np.random.default_rng(9)
+    # two communities: users follow mostly within their parity group
+    for u in range(24):
+        group = u % 2
+        for v in range(24):
+            if v == u:
+                continue
+            p = 0.5 if (v % 2) == group else 0.04
+            if rng.random() < p:
+                events.append(Event(
+                    event="follow", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="user", target_entity_id=f"u{v}"))
+    store.insert_batch(events, app_id)
+    return "FollowApp"
+
+
+def test_recommended_user_engine(follow_app):
+    """recommended-user variant: user-to-user similarity over the follow
+    graph (examples/scala-parallel-similarproduct/recommended-user)."""
+    from predictionio_tpu.engines.recommended_user import (
+        Query, default_engine_params, engine,
+    )
+
+    eng = engine()
+    ep = default_engine_params(follow_app, rank=8, num_iterations=10)
+    instance = run_train(
+        eng, ep,
+        engine_factory="predictionio_tpu.engines.recommended_user:engine")
+    result, _ctx = load_for_deploy(eng, instance)
+    algo, model = result.algorithms[0], result.models[0]
+
+    out = algo.predict(model, Query(users=("u2",), num=5)).similar_user_scores
+    assert len(out) == 5
+    assert all(s.score > 0 for s in out)
+    assert "u2" not in {s.user for s in out}          # never the query user
+    # community structure recovered: similar users share u2's parity
+    same = sum(int(s.user[1:]) % 2 == 0 for s in out)
+    assert same >= 4, out
+    # scores sorted descending
+    scores = [s.score for s in out]
+    assert scores == sorted(scores, reverse=True)
+
+    # multi-user query + blacklist + whitelist
+    out = algo.predict(model, Query(users=("u2", "u4"), num=4,
+                                    black_list=("u6",))).similar_user_scores
+    assert "u6" not in {s.user for s in out}
+    out = algo.predict(model, Query(users=("u2",), num=4,
+                                    white_list=("u8", "u10"))
+                       ).similar_user_scores
+    assert {s.user for s in out} <= {"u8", "u10"}
+    # unknown users -> empty, not an error
+    assert algo.predict(model, Query(users=("ghost",), num=3)
+                        ).similar_user_scores == []
+
+
+def test_recommended_user_wire_format(follow_app):
+    """Wire parity: {"users", "num"} -> {"similarUserScores": [...]}"""
+    from predictionio_tpu.core.params import params_from_json
+    from predictionio_tpu.engines.recommended_user import (
+        Query, default_engine_params, engine,
+    )
+
+    q = params_from_json({"users": ["u1"], "num": 2,
+                          "blackList": ["u3"]}, Query)
+    assert q.users == ("u1",) and q.black_list == ("u3",)
+    eng = engine()
+    ep = default_engine_params(follow_app, rank=8, num_iterations=8)
+    instance = run_train(
+        eng, ep,
+        engine_factory="predictionio_tpu.engines.recommended_user:engine")
+    result, _ctx = load_for_deploy(eng, instance)
+    d = result.algorithms[0].predict(result.models[0], q).to_dict()
+    assert set(d) == {"similarUserScores"}
+    for s in d["similarUserScores"]:
+        assert set(s) == {"user", "score"}

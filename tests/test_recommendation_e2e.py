@@ -215,3 +215,121 @@ async def test_concurrent_queries_micro_batched(app_with_ratings):
             assert a["score"] == pytest.approx(b["score"], abs=1e-4)
     finally:
         await c.close()
+
+
+async def test_blacklist_whitelist_query(app_with_ratings):
+    """blacklist-items variant parity: Query carries blackList/whiteList
+    (camelCase on the wire) and the served scores honor them."""
+    engine, instance = train_instance(app_with_ratings)
+    result, ctx = load_for_deploy(engine, instance)
+    server = create_query_server(engine, result, instance, ctx)
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/queries.json", json={"user": "u1", "num": 5})
+        base = [s["item"] for s in (await resp.json())["itemScores"]]
+        assert len(base) == 5
+
+        # blacklist the current top-2: they disappear, the rest shift up
+        resp = await c.post("/queries.json", json={
+            "user": "u1", "num": 5, "blackList": base[:2]})
+        filtered = [s["item"] for s in (await resp.json())["itemScores"]]
+        assert base[0] not in filtered and base[1] not in filtered
+        assert filtered[:3] == base[2:5]
+
+        # whitelist restricts scoring to the allowed set
+        resp = await c.post("/queries.json", json={
+            "user": "u1", "num": 5, "whiteList": base[1:3]})
+        allowed = [s["item"] for s in (await resp.json())["itemScores"]]
+        assert sorted(allowed) == sorted(base[1:3])
+    finally:
+        await c.close()
+
+
+def test_blacklist_batch_matches_serial(app_with_ratings):
+    """The vectorized batch path applies per-query filters identically to
+    the serial predict path."""
+    from predictionio_tpu.engines.recommendation import Query
+
+    engine, instance = train_instance(app_with_ratings)
+    result, _ctx = load_for_deploy(engine, instance)
+    algo = result.algorithms[0]
+    model = result.models[0]
+    queries = [
+        Query(user="u1", num=4),
+        Query(user="u1", num=4, black_list=("i1", "i3")),
+        Query(user="u2", num=3, white_list=("i0", "i2", "i4")),
+    ]
+    serial = [algo.predict(model, q).to_dict() for q in queries]
+    batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+    for i, want in enumerate(serial):
+        got = batched[i].to_dict()
+        assert [s["item"] for s in got["itemScores"]] == \
+            [s["item"] for s in want["itemScores"]]
+        # scores agree up to f32 matvec-vs-matmul reduction order
+        np.testing.assert_allclose(
+            [s["score"] for s in got["itemScores"]],
+            [s["score"] for s in want["itemScores"]], rtol=1e-5)
+    assert all("i1" != s["item"] and "i3" != s["item"]
+               for s in serial[1]["itemScores"])
+    assert {s["item"] for s in serial[2]["itemScores"]} <= {"i0", "i2", "i4"}
+
+
+def test_view_event_training_variant(tmp_path):
+    """train-with-view-event variant: eventNames=["view"] trains implicit
+    ALS from view counts alone (no rating property anywhere)."""
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite",
+                           "PATH": str(tmp_path / "view.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    from predictionio_tpu.data.eventstore import clear_cache
+    clear_cache()
+    try:
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="ViewApp"))
+        store = Storage.get_events()
+        store.init_channel(app_id)
+        rng = np.random.default_rng(5)
+        events = []
+        for u in range(24):
+            for it in range(16):
+                # odd users repeatedly view odd items (and vice versa)
+                n_views = int(rng.integers(2, 5)) \
+                    if (u % 2) == (it % 2) else \
+                    (1 if rng.random() < 0.1 else 0)
+                for _ in range(n_views):
+                    events.append(Event(
+                        event="view", entity_type="user", entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{it}"))
+        store.insert_batch(events, app_id)
+
+        from predictionio_tpu.core.params import EngineParams
+        from predictionio_tpu.engines.recommendation import (
+            AlgorithmParams, DataSourceParams, Query,
+        )
+
+        engine = engine_factory()
+        ep = EngineParams(
+            data_source_params=DataSourceParams(
+                app_name="ViewApp", event_names=["view"]),
+            algorithm_params_list=[("als", AlgorithmParams(
+                rank=8, num_iterations=10, implicit_prefs=True))])
+        instance = run_train(
+            engine, ep,
+            engine_factory="predictionio_tpu.engines.recommendation:engine")
+        assert instance.status == "COMPLETED"
+        result, _ctx = load_for_deploy(engine, instance)
+        algo, model = result.algorithms[0], result.models[0]
+        top = algo.predict(model, Query(user="u1", num=6)).item_scores
+        assert len(top) == 6
+        odd = sum(int(s.item[1:]) % 2 == 1 for s in top)
+        assert odd >= 4, f"view-trained model lost the structure: {top}"
+    finally:
+        Storage.reset()
+        clear_cache()

@@ -1,36 +1,47 @@
-"""Benchmark: the judged configs (BASELINE.md) as one unkillable suite.
+"""Benchmark: the judged configs (BASELINE.md) as one fault-isolated suite.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Design (round-2 rebuild after BENCH_r01 died in backend init):
+Design (round-4 rebuild; BENCH_r03 post-mortem):
 
-* The orchestrator process NEVER imports jax. Every config — and the
-  backend probe itself — runs in a subprocess with a hard timeout, so a
-  wedged TPU tunnel or a crashing config costs that one subprocess, not
-  the suite: partial results always beat rc=1.
-* Platform resolution: BENCH_PLATFORM env override, else probe the
-  JAX_PLATFORMS platform (the real chip) with retry+backoff, else fall
-  back to CPU. Workers force the platform through jax.config because
-  device plugins override the env var (utils/config.honor_jax_platforms).
+* BENCH_r03 (the first real-TPU run) died with rc=124: the per-config
+  subprocess model re-claimed the tunneled TPU chip for every config, and
+  claim #3 hung for its whole 900s budget with zero diagnostics. Measured
+  here: a TPU claim through the axon relay can take minutes or hang
+  indefinitely, while `import jax` is instant. So round 4 claims the chip
+  ONCE: a single long-lived jax worker runs every config sequentially,
+  fed one config name at a time over stdin by an orchestrator that never
+  imports jax.
+* Heartbeats: the worker stamps every phase (init, data-build, compile,
+  train, query) to stderr; the orchestrator echoes them and keeps the
+  tail, so a hang always leaves evidence of WHERE.
+* Watchdogs: per-config budgets + an overall deadline (BENCH_DEADLINE_S,
+  default 1500s — the driver's own timeout killed the r03 suite, so the
+  suite now ends itself first and always prints its final line). SIGTERM
+  dumps partial results instead of dying silently.
+* Fallback ladder: TPU worker init hangs -> one retry -> CPU worker for
+  whatever remains. A config that wedges the TPU worker is retried on
+  the CPU worker (flagged by its per-config "platform" field) — partial
+  numbers beat holes.
 * Baselines are MEASURED single-process numpy runs of the same math (the
-  stand-in for stock Spark-local; the reference publishes no numbers).
-  Only the 20M config extrapolates — linearly from a measured >=4M-rating
-  numpy run, flagged in its JSON.
-* MFU: an analytic FLOP model of the ALS sweep (gram nnz*K^2 + solve
-  segs*K^3 MACs) against the chip's bf16 peak — an estimate (the math
-  runs in f32), reported per config next to wall-clock.
+  stand-in for stock Spark-local; the reference publishes no numbers,
+  BASELINE.md). They run in a SEPARATE no-jax subprocess, overlapped
+  with the worker's TPU claim, and extrapolate from a measured iteration
+  subset where flagged (`baseline_measured_iters`).
+* MFU: an analytic FLOP model (als_model_flops) against the chip's bf16
+  peak — an estimate (the math runs in f32), reported per config.
 
-Configs:
+Configs (order = bank cheap+judged numbers first, riskiest last):
+  als_ml100k        recommendation ALS kernel @ MovieLens-100K shape
   pipeline_ml100k   the judged path: 100k rate events -> sqlite event
                     store -> run_train workflow (`pio train` wall-clock)
                     -> deploy -> 1k HTTP /queries.json, p50/p99
-  als_ml100k        recommendation ALS kernel @ MovieLens-100K shape
   cooccurrence_ml1m similarproduct cooccurrence @ ML-1M shape
   naive_bayes_spam  classification NB, spam/ham scale
   ecommerce_implicit_als  implicit ALS (view+buy confidence) + top-N
   eval_sweep_3fold_3rank  cross-validated ALS hyperparameter sweep
-  als_ml20m         MovieLens-20M-shape ALS on one chip: 20M ratings,
+  als_ml20m         MovieLens-20M ALS on one chip: 20M ratings,
                     138k x 27k, string-id assignment + data build +
                     train + RMSE all timed (north star, BASELINE.md)
 """
@@ -40,21 +51,33 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
 RANK, ITERS, REG = 10, 20, 0.01
 
+T0 = time.time()
+
 
 def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+    print(f"[bench +{time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def hb(phase: str) -> None:
+    """Worker-side heartbeat: timestamped phase marker on stderr, echoed
+    by the orchestrator — a killed worker's last heartbeat tells WHERE it
+    hung (the diagnostic BENCH_r03 lacked)."""
+    print(f"HB {time.time() - T0:.1f} {phase}", file=sys.stderr, flush=True)
 
 
 # ---------------------------------------------------------------------------
-# Synthetic data + measured numpy baselines (no jax)
+# Synthetic data + measured numpy baselines (no jax anywhere here)
 # ---------------------------------------------------------------------------
 
 def synthetic_ratings(n_users, n_items, nnz, seed=0, implicit=False):
@@ -103,9 +126,9 @@ def _np_half_sweep(F, seg, tgt, val, n_seg, rank, reg, implicit=False,
 def numpy_als_baseline(users, items, ratings, nu, ni, rank, iters, reg=REG,
                        implicit=False, alpha=1.0, measure_iters=None,
                        seed=1):
-    """MEASURED full numpy ALS run (both sides per iteration). When
+    """MEASURED numpy ALS run (both sides per iteration). When
     `measure_iters` < iters, the measured iterations are extrapolated
-    linearly (flagged by the caller in its JSON)."""
+    linearly (ALS iterations are uniform cost; flagged by the caller)."""
     rng = np.random.default_rng(seed)
     V = rng.normal(size=(ni, rank)).astype(np.float32) / np.sqrt(rank)
     run = min(measure_iters or iters, iters)
@@ -117,6 +140,120 @@ def numpy_als_baseline(users, items, ratings, nu, ni, rank, iters, reg=REG,
                            implicit, alpha)
     dt = time.perf_counter() - t0
     return dt * (iters / run), run
+
+
+def base_als_ml100k():
+    nu, ni, nnz = 943, 1682, 100_000
+    users, items, ratings = synthetic_ratings(nu, ni, nnz)
+    base, measured = numpy_als_baseline(users, items, ratings, nu, ni,
+                                        RANK, ITERS, measure_iters=5)
+    return {"baseline_s": round(base, 3), "baseline_measured_iters": measured}
+
+
+def base_cooccurrence():
+    nu, ni, nnz = 6040, 3706, 1_000_000
+    users, items, _ = synthetic_ratings(nu, ni, nnz, seed=2)
+    pairs = np.unique(
+        users.astype(np.int64) * ni + items.astype(np.int64))
+    users, items = (pairs // ni).astype(np.int32), (pairs % ni).astype(np.int32)
+    n_top = 20
+    t0 = time.perf_counter()
+    a = np.zeros((nu, ni), np.float32)
+    a[users, items] = 1.0
+    c_np = a.T @ a
+    np.fill_diagonal(c_np, 0.0)
+    np.argpartition(-c_np, kth=n_top, axis=1)[:, :n_top]
+    base = time.perf_counter() - t0
+    return {"baseline_s": round(base, 3)}
+
+
+def _nb_data():
+    n_docs, vocab = 20_000, 2_000
+    rng = np.random.default_rng(3)
+    labels = np.where(rng.random(n_docs) < 0.4, "spam", "ham")
+    X = rng.poisson(
+        np.where((labels == "spam")[:, None],
+                 rng.random(vocab) * 2.0, rng.random(vocab) * 1.2)
+    ).astype(np.float32)
+    return X, labels
+
+
+def base_naive_bayes():
+    X, labels = _nb_data()
+    n_docs, vocab = X.shape
+    t0 = time.perf_counter()
+    lv, codes = np.unique(labels, return_inverse=True)
+    counts = np.zeros((len(lv), vocab), np.float64)
+    np.add.at(counts, codes, X)
+    prior = np.log(np.bincount(codes) / n_docs)
+    prob = np.log((counts + 1.0) / (counts + 1.0).sum(1, keepdims=True))
+    (X @ prob.T.astype(np.float32) + prior[None, :]).argmax(1)
+    base = time.perf_counter() - t0
+    return {"baseline_s": round(base, 3)}
+
+
+def base_ecommerce():
+    nu, ni, nnz = 2000, 1500, 200_000
+    users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=4,
+                                              implicit=True)
+    base, measured = numpy_als_baseline(users, items, ratings, nu, ni,
+                                        RANK, 10, implicit=True,
+                                        measure_iters=3)
+    return {"baseline_s": round(base, 3), "baseline_measured_iters": measured}
+
+
+def base_eval_sweep():
+    nu, ni, nnz = 943, 1682, 100_000
+    users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=5)
+    k_fold, ranks, iters = 3, (8, 10, 12), 5
+    fold_of = np.arange(nnz) % k_fold
+    # one fold per rank measured, x k_fold (folds are uniform cost)
+    t0 = time.perf_counter()
+    for rank in ranks:
+        tr = fold_of != 0
+        numpy_als_baseline(users[tr], items[tr], ratings[tr], nu, ni,
+                           rank, iters)
+    base = (time.perf_counter() - t0) * k_fold
+    return {"baseline_s": round(base, 3), "baseline_measured_folds": 1}
+
+
+def base_als_ml20m():
+    nu, ni, nnz = 138_000, 27_000, 20_000_000
+    users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=20)
+    cap = 4_000_000
+    base_cap, measured = numpy_als_baseline(
+        users[:cap], items[:cap], ratings[:cap], nu, ni, RANK, ITERS,
+        measure_iters=1)
+    base = base_cap * (nnz / cap)
+    return {"baseline_s": round(base, 2), "baseline_measured_iters": measured,
+            "baseline_extrapolated_from_nnz": cap}
+
+
+BASELINES = {
+    "als_ml100k": base_als_ml100k,
+    "cooccurrence_ml1m": base_cooccurrence,
+    "naive_bayes_spam": base_naive_bayes,
+    "ecommerce_implicit_als": base_ecommerce,
+    "eval_sweep_3fold_3rank": base_eval_sweep,
+    "als_ml20m": base_als_ml20m,
+}
+
+
+def worker_baselines(names) -> None:
+    """No-jax subprocess: measure numpy baselines, one JSON line each (so
+    a crash/timeout keeps everything already measured)."""
+    for name in names:
+        fn = BASELINES.get(name)
+        if fn is None:
+            continue
+        hb(f"baseline-start {name}")
+        try:
+            out = fn()
+        except Exception as e:      # one bad baseline must not eat the rest
+            log(f"baseline {name} failed: {e!r}")
+            continue
+        print("BASELINE " + json.dumps({"name": name, **out}), flush=True)
+    print("BASELINES_DONE", flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -172,6 +309,52 @@ def setup_backend(platform: str):
 # Configs — each returns a detail dict
 # ---------------------------------------------------------------------------
 
+def _als_device_data(jax, mesh, users, items, ratings, nu, ni):
+    """ALSData built on host then committed to the mesh ONCE — the timed
+    train consumes resident arrays, so tunnel transfer time is reported
+    separately (`transfer_s`) instead of polluting the train number."""
+    from predictionio_tpu.models.als import ALSData
+
+    t0 = time.perf_counter()
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    data = data.put(mesh)
+    transfer_s = time.perf_counter() - t0
+    return data, build_s, transfer_s
+
+
+def cfg_als_ml100k(jax, mesh, platform):
+    """Config 1 kernel: recommendation ALS @ ML-100K shape."""
+    from predictionio_tpu.models.als import ALSParams, train_als
+    from predictionio_tpu.models.als import rmse as als_rmse
+
+    nu, ni, nnz = 943, 1682, 100_000
+    users, items, ratings = synthetic_ratings(nu, ni, nnz)
+    params = ALSParams(rank=RANK, num_iterations=ITERS, reg=REG,
+                       chunk_size=16384)
+    hb("als_ml100k data-build")
+    data, build_s, transfer_s = _als_device_data(
+        jax, mesh, users, items, ratings, nu, ni)
+    hb("als_ml100k compile+warmup")
+    t0 = time.perf_counter()
+    train_als(mesh, data, params)          # warm-up (compile + first run)
+    warm_s = time.perf_counter() - t0
+    hb("als_ml100k train")
+    t0 = time.perf_counter()
+    U, V = train_als(mesh, data, params)
+    elapsed = time.perf_counter() - t0
+    err = als_rmse(U, V, users, items, ratings)
+    assert np.isfinite(err), "ALS diverged"
+    flops = als_model_flops(nnz, nu, ni, RANK, ITERS)
+    return {"elapsed_s": round(elapsed, 4),
+            "build_s": round(build_s, 3),
+            "transfer_s": round(transfer_s, 3),
+            "compile_s": round(warm_s - elapsed, 3),
+            "model_flops": flops,
+            "note": f"train-RMSE {err:.3f}"}
+
+
 def cfg_pipeline_ml100k(jax, mesh, platform):
     """The judged workload boundary (BASELINE.md target metrics): events
     in the store -> `pio train` equivalent -> deploy -> HTTP query
@@ -208,6 +391,7 @@ def cfg_pipeline_ml100k(jax, mesh, platform):
         store = Storage.get_events()
         store.init_channel(app_id)
 
+        hb("pipeline import-events")
         t0 = time.perf_counter()
         batch = []
         for u, i, r in zip(users, items, ratings):
@@ -225,12 +409,24 @@ def cfg_pipeline_ml100k(jax, mesh, platform):
         engine = engine_factory()
         ep = default_engine_params("BenchApp", rank=RANK,
                                    num_iterations=ITERS)
+        hb("pipeline train (cold: read+build+compile+train)")
         t0 = time.perf_counter()
         instance = run_train(
             engine, ep,
             engine_factory="predictionio_tpu.engines.recommendation:engine")
         train_s = time.perf_counter() - t0   # the `pio train` wall-clock
 
+        # warm `pio train`: same workflow again — compile is cached, so
+        # this separates XLA-compile cost from the steady-state train the
+        # judge compares against Spark re-runs (VERDICT r3 item 3)
+        hb("pipeline train (warm)")
+        t0 = time.perf_counter()
+        instance = run_train(
+            engine, ep,
+            engine_factory="predictionio_tpu.engines.recommendation:engine")
+        train_warm_s = time.perf_counter() - t0
+
+        hb("pipeline deploy")
         t0 = time.perf_counter()
         result, ctx = load_for_deploy(engine, instance)
         deploy_s = time.perf_counter() - t0
@@ -241,6 +437,8 @@ def cfg_pipeline_ml100k(jax, mesh, platform):
 
         server = create_query_server(engine, result, instance, ctx)
         lat = []
+
+        hb("pipeline queries")
 
         async def drive():
             c = TestClient(TestServer(server.app))
@@ -270,62 +468,51 @@ def cfg_pipeline_ml100k(jax, mesh, platform):
     return {
         "elapsed_s": round(train_s, 3),
         "baseline_s": None,
-        "note": (f"import {import_s:.1f}s, pio-train {train_s:.2f}s, "
-                 f"deploy {deploy_s:.2f}s, query p50 {p50:.2f}ms "
-                 f"p99 {p99:.2f}ms over 1000 HTTP queries"),
+        "note": (f"import {import_s:.1f}s, pio-train {train_s:.2f}s "
+                 f"(warm {train_warm_s:.2f}s), deploy {deploy_s:.2f}s, "
+                 f"query p50 {p50:.2f}ms p99 {p99:.2f}ms over 1000 HTTP "
+                 "queries"),
         "import_s": round(import_s, 2),
         "train_s": round(train_s, 3),
+        "train_warm_s": round(train_warm_s, 3),
         "deploy_s": round(deploy_s, 3),
         "query_p50_ms": round(p50, 3),
         "query_p99_ms": round(p99, 3),
     }
 
 
-def cfg_als_ml100k(jax, mesh, platform):
-    """Config 1 kernel: recommendation ALS @ ML-100K shape; measured
-    numpy baseline is a FULL run of the same math (not extrapolated)."""
-    from predictionio_tpu.models.als import ALSData, ALSParams, train_als
-    from predictionio_tpu.models.als import rmse as als_rmse
-
-    nu, ni, nnz = 943, 1682, 100_000
-    users, items, ratings = synthetic_ratings(nu, ni, nnz)
-    base, measured = numpy_als_baseline(users, items, ratings, nu, ni,
-                                        RANK, ITERS)
-    params = ALSParams(rank=RANK, num_iterations=ITERS, reg=REG,
-                       chunk_size=16384)
-    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
-    train_als(mesh, data, params)          # warm-up compile
-    t0 = time.perf_counter()
-    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
-    U, V = train_als(mesh, data, params)
-    elapsed = time.perf_counter() - t0
-    err = als_rmse(U, V, users, items, ratings)
-    assert np.isfinite(err), "ALS diverged"
-    flops = als_model_flops(nnz, nu, ni, RANK, ITERS)
-    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
-            "baseline_measured_iters": measured,
-            "model_flops": flops,
-            "note": f"train-RMSE {err:.3f}"}
-
-
 def cfg_als_ml20m(jax, mesh, platform):
     """North-star shape (BASELINE.md): 20M ratings, 138k users x 27k
     items, trained end-to-end on one chip — string-id assignment, data
-    build, train, RMSE all timed. On the CPU fallback the shape scales
-    down (flagged) so partial results still arrive."""
+    build, transfer, train, RMSE all timed separately. On the CPU
+    fallback the shape scales down (flagged) so partial results still
+    arrive."""
     from predictionio_tpu.data.bimap import assign_indices
-    from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+    from predictionio_tpu.models.als import ALSParams, train_als
     from predictionio_tpu.models.als import rmse as als_rmse
 
     if platform == "cpu":
         nu, ni, nnz, iters, scaled = 30_000, 10_000, 2_000_000, 5, True
     else:
         nu, ni, nnz, iters, scaled = 138_000, 27_000, 20_000_000, ITERS, False
+    hb("ml20m synth-data")
     users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=20)
+    detail = {}
+    if scaled:
+        # the out-of-process baseline measured the FULL 20M/20-iter shape;
+        # a scaled-down run must carry its own matched baseline or the
+        # speedup would compare different workloads
+        hb("ml20m scaled inline baseline")
+        base, measured = numpy_als_baseline(
+            users, items, ratings, nu, ni, RANK, iters, measure_iters=1)
+        detail.update({"baseline_s": round(base, 2),
+                       "baseline_measured_iters": measured,
+                       "baseline_note": "matched to the scaled CPU shape"})
 
     # the BiMap.scala:126-128 hard part: string ids -> contiguous indices
     user_ids = users.astype("U8")
     item_ids = items.astype("U8")
+    hb("ml20m id-assign")
     t0 = time.perf_counter()
     user_vocab, user_codes = assign_indices(user_ids)
     item_vocab, item_codes = assign_indices(item_ids)
@@ -333,76 +520,61 @@ def cfg_als_ml20m(jax, mesh, platform):
     del user_ids, item_ids
     nu_r, ni_r = len(user_vocab), len(item_vocab)
 
-    t0 = time.perf_counter()
-    data = ALSData.build(user_codes, item_codes, ratings, nu_r, ni_r,
-                         n_shards=1)
-    build_s = time.perf_counter() - t0
-
+    hb("ml20m data-build")
+    data, build_s, transfer_s = _als_device_data(
+        jax, mesh, user_codes, item_codes, ratings, nu_r, ni_r)
     params = ALSParams(rank=RANK, num_iterations=iters, reg=REG,
                        chunk_size=16384)
+    hb("ml20m compile+warmup")
+    t0 = time.perf_counter()
     train_als(mesh, data, params)               # warm-up compile
+    warm_s = time.perf_counter() - t0
+    hb("ml20m train")
     t0 = time.perf_counter()
     U, V = train_als(mesh, data, params)
     train_s = time.perf_counter() - t0
+    hb("ml20m rmse")
     err = als_rmse(U, V, user_codes[:1_000_000], item_codes[:1_000_000],
                    ratings[:1_000_000])
     assert np.isfinite(err), "ALS diverged"
-
-    # numpy baseline measured on a >=4M-rating run, extrapolated linearly
-    cap = min(nnz, 4_000_000)
-    bi = max(1, min(2, iters))
-    base_cap, measured = numpy_als_baseline(
-        user_codes[:cap], item_codes[:cap], ratings[:cap], nu_r, ni_r,
-        RANK, iters, measure_iters=bi)
-    base = base_cap * (nnz / cap)
     flops = als_model_flops(nnz, nu_r, ni_r, RANK, iters)
-    return {"elapsed_s": round(train_s, 3), "baseline_s": round(base, 2),
-            "baseline_measured_iters": measured,
-            "baseline_extrapolated_from_nnz": cap,
-            "model_flops": flops, "scaled_for_cpu": scaled,
-            "nnz": nnz,
-            "note": (f"{nnz / 1e6:.0f}M ratings {nu_r}x{ni_r}: id-assign "
-                     f"{id_assign_s:.1f}s, build {build_s:.1f}s, train "
-                     f"{train_s:.2f}s ({iters} iters), RMSE {err:.3f}"),
-            "id_assign_s": round(id_assign_s, 2),
-            "build_s": round(build_s, 2)}
+    detail.update({
+        "elapsed_s": round(train_s, 3),
+        "model_flops": flops, "scaled_for_cpu": scaled,
+        "nnz": nnz,
+        "note": (f"{nnz / 1e6:.0f}M ratings {nu_r}x{ni_r}: id-assign "
+                 f"{id_assign_s:.1f}s, build {build_s:.1f}s, transfer "
+                 f"{transfer_s:.1f}s, train {train_s:.2f}s ({iters} "
+                 f"iters, compile {warm_s - train_s:.1f}s), "
+                 f"RMSE {err:.3f}"),
+        "id_assign_s": round(id_assign_s, 2),
+        "build_s": round(build_s, 2),
+        "transfer_s": round(transfer_s, 2),
+        "compile_s": round(warm_s - train_s, 2)})
+    return detail
 
 
 def cfg_cooccurrence(jax, mesh, platform):
-    """Config 2: similarproduct cooccurrence @ ML-1M shape."""
-    import jax.numpy as jnp
-
-    from predictionio_tpu.models.cooccurrence import distinct_pairs
+    """Config 2: similarproduct cooccurrence @ ML-1M shape. The count
+    matrix A^T A runs as ONE bf16 MXU matmul over the host-built
+    user-item incidence matrix (models/cooccurrence.py)."""
+    from predictionio_tpu.models.cooccurrence import (
+        cooccurrence_topn, distinct_pairs)
 
     nu, ni, nnz = 6040, 3706, 1_000_000
     users, items, _ = synthetic_ratings(nu, ni, nnz, seed=2)
     users, items = distinct_pairs(users, items)
     n_top = 20
 
-    # numpy baseline: same math — dense A^T A + per-row top-N
+    hb("cooccurrence warmup")
+    cooccurrence_topn(mesh, users, items, nu, ni, n_top)   # compile
+    hb("cooccurrence timed")
     t0 = time.perf_counter()
-    a = np.zeros((nu, ni), np.float32)
-    a[users, items] = 1.0
-    c_np = a.T @ a
-    np.fill_diagonal(c_np, 0.0)
-    np.argpartition(-c_np, kth=n_top, axis=1)[:, :n_top]
-    base = time.perf_counter() - t0
-
-    @jax.jit
-    def count_topn(u, i):
-        am = jnp.zeros((nu, ni), jnp.float32).at[u, i].set(1.0)
-        c = am.T @ am
-        c = c * (1.0 - jnp.eye(ni, dtype=jnp.float32))
-        return jax.lax.top_k(c, n_top)
-
-    count_topn(jnp.asarray(users), jnp.asarray(items))   # warm-up
-    t0 = time.perf_counter()
-    scores, idx = count_topn(jnp.asarray(users), jnp.asarray(items))
-    jax.block_until_ready((scores, idx))
+    scores, idx = cooccurrence_topn(mesh, users, items, nu, ni, n_top)
     elapsed = time.perf_counter() - t0
     # matmul-dominated: A^T A is 2 * nu * ni^2 flops
     flops = 2.0 * nu * ni * ni
-    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
+    return {"elapsed_s": round(elapsed, 4),
             "model_flops": flops,
             "note": f"{len(users)} distinct pairs"}
 
@@ -411,48 +583,30 @@ def cfg_naive_bayes(jax, mesh, platform):
     """Config 3: classification NaiveBayes, spam/ham-scale."""
     from predictionio_tpu.models.naive_bayes import train_multinomial_nb
 
-    n_docs, vocab = 20_000, 2_000
-    rng = np.random.default_rng(3)
-    labels = np.where(rng.random(n_docs) < 0.4, "spam", "ham")
-    X = rng.poisson(
-        np.where((labels == "spam")[:, None],
-                 rng.random(vocab) * 2.0, rng.random(vocab) * 1.2)
-    ).astype(np.float32)
-
-    # numpy baseline: same math (count, smooth, log, score matmul)
+    X, labels = _nb_data()
+    hb("naive_bayes warmup")
+    model = train_multinomial_nb(X, labels, mesh=mesh)     # warm-up
+    hb("naive_bayes timed")
     t0 = time.perf_counter()
-    lv, codes = np.unique(labels, return_inverse=True)
-    counts = np.zeros((len(lv), vocab), np.float64)
-    np.add.at(counts, codes, X)
-    prior = np.log(np.bincount(codes) / n_docs)
-    prob = np.log((counts + 1.0) / (counts + 1.0).sum(1, keepdims=True))
-    (X @ prob.T.astype(np.float32) + prior[None, :]).argmax(1)
-    base = time.perf_counter() - t0
-
-    model = train_multinomial_nb(X, labels)              # warm-up
-    t0 = time.perf_counter()
-    model = train_multinomial_nb(X, labels)
+    model = train_multinomial_nb(X, labels, mesh=mesh)
     pred = model.predict(X)
     elapsed = time.perf_counter() - t0
     acc = float((pred == labels).mean())
     assert acc > 0.9, f"NB accuracy {acc}"
-    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
+    return {"elapsed_s": round(elapsed, 4),
             "note": f"accuracy {acc:.3f}"}
 
 
 def cfg_ecommerce(jax, mesh, platform):
-    """Config 4: ecommerce implicit ALS (view+buy confidence) + top-N;
-    measured numpy baseline runs the same implicit math in full."""
+    """Config 4: ecommerce implicit ALS (view+buy confidence) + top-N."""
     import jax.numpy as jnp
 
-    from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+    from predictionio_tpu.models.als import ALSParams, train_als
 
     nu, ni, nnz = 2000, 1500, 200_000
     users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=4,
                                               implicit=True)
     iters = 10
-    base, measured = numpy_als_baseline(users, items, ratings, nu, ni,
-                                        RANK, iters, implicit=True)
     params = ALSParams(rank=RANK, num_iterations=iters, reg=REG,
                        implicit_prefs=True, alpha=1.0, chunk_size=16384)
 
@@ -460,24 +614,26 @@ def cfg_ecommerce(jax, mesh, platform):
     def topn(u_all, v):
         return jax.lax.top_k(u_all @ v.T, 10)
 
-    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    hb("ecommerce data-build")
+    data, build_s, transfer_s = _als_device_data(
+        jax, mesh, users, items, ratings, nu, ni)
+    hb("ecommerce warmup")
     U, V = train_als(mesh, data, params)   # warm-up train ...
     jax.block_until_ready(topn(jnp.asarray(U), jnp.asarray(V)))
+    hb("ecommerce timed")
     t0 = time.perf_counter()
-    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
     U, V = train_als(mesh, data, params)
     scores, idx = topn(jnp.asarray(U), jnp.asarray(V))
     jax.block_until_ready((scores, idx))
     elapsed = time.perf_counter() - t0
     flops = als_model_flops(nnz, nu, ni, RANK, iters)
-    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
-            "baseline_measured_iters": measured, "model_flops": flops,
+    return {"elapsed_s": round(elapsed, 4), "model_flops": flops,
             "note": "implicit ALS + batch top-10"}
 
 
 def cfg_eval_sweep(jax, mesh, platform):
-    """Config 5: 3-fold x 3-rank cross-validated ALS sweep; the numpy
-    baseline runs the IDENTICAL sweep in full."""
+    """Config 5: 3-fold x 3-rank cross-validated ALS sweep (the numpy
+    baseline runs the identical sweep)."""
     from predictionio_tpu.models.als import ALSData, ALSParams, train_als
     from predictionio_tpu.models.als import rmse as als_rmse
 
@@ -485,14 +641,6 @@ def cfg_eval_sweep(jax, mesh, platform):
     users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=5)
     k_fold, ranks, iters = 3, (8, 10, 12), 5
     fold_of = np.arange(nnz) % k_fold
-
-    t0 = time.perf_counter()
-    for rank in ranks:
-        for f in range(k_fold):
-            tr = fold_of != f
-            numpy_als_baseline(users[tr], items[tr], ratings[tr], nu, ni,
-                               rank, iters)
-    base = time.perf_counter() - t0
 
     def sweep():
         best = (None, np.inf)
@@ -513,218 +661,409 @@ def cfg_eval_sweep(jax, mesh, platform):
                 best = (rank, mean_err)
         return best
 
+    hb("eval_sweep warmup (3 rank compiles)")
     sweep()                                 # warm-up (compile per rank)
+    hb("eval_sweep timed")
     t0 = time.perf_counter()
     best_rank, best_err = sweep()
     elapsed = time.perf_counter() - t0
     flops = sum(als_model_flops(nnz * (k_fold - 1) // k_fold, nu, ni, r,
                                 iters) * k_fold for r in ranks)
-    return {"elapsed_s": round(elapsed, 4), "baseline_s": round(base, 3),
+    return {"elapsed_s": round(elapsed, 4),
             "model_flops": flops,
             "note": f"best rank {best_rank}, test-RMSE {best_err:.3f}"}
 
 
+#: name -> (fn, seconds budget measured from RUN dispatch to BENCH_DETAIL)
 CONFIGS = {
-    "pipeline_ml100k": (cfg_pipeline_ml100k, 1200),
-    "als_ml100k": (cfg_als_ml100k, 900),
-    "cooccurrence_ml1m": (cfg_cooccurrence, 600),
-    "naive_bayes_spam": (cfg_naive_bayes, 600),
-    "ecommerce_implicit_als": (cfg_ecommerce, 900),
-    "eval_sweep_3fold_3rank": (cfg_eval_sweep, 1200),
-    "als_ml20m": (cfg_als_ml20m, 2700),
+    "als_ml100k": (cfg_als_ml100k, 240),
+    "pipeline_ml100k": (cfg_pipeline_ml100k, 420),
+    "cooccurrence_ml1m": (cfg_cooccurrence, 240),
+    "naive_bayes_spam": (cfg_naive_bayes, 180),
+    "ecommerce_implicit_als": (cfg_ecommerce, 240),
+    "eval_sweep_3fold_3rank": (cfg_eval_sweep, 420),
+    "als_ml20m": (cfg_als_ml20m, 900),
 }
 
+INIT_BUDGET_S = 420      # TPU claim through the relay; measured in minutes
+
 
 # ---------------------------------------------------------------------------
-# Worker entry points
+# Worker: claims the device ONCE, then runs configs fed over stdin
 # ---------------------------------------------------------------------------
 
-def worker_probe(platform: str) -> None:
-    jax, devices, _mesh = setup_backend(platform)
+def worker_loop(platform: str) -> None:
+    hb(f"worker init-start platform={platform}")
+    jax, devices, mesh = setup_backend(platform)
     import jax.numpy as jnp
 
     x = jnp.ones((256, 256))
     jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
-    print(json.dumps({"ok": True, "platform": platform,
-                      "n_devices": len(devices),
-                      "device_kind": devices[0].device_kind}), flush=True)
+    hb("worker first-dispatch ok")
+    print("DEVINFO " + json.dumps({
+        "platform": platform, "n_devices": len(devices),
+        "device_kind": devices[0].device_kind}), flush=True)
+    for line in sys.stdin:
+        name = line.strip()
+        if not name or name == "QUIT":
+            break
+        fn, _budget = CONFIGS[name]
+        hb(f"config-start {name}")
+        t0 = time.perf_counter()
+        try:
+            detail = fn(jax, mesh, platform)
+        except Exception as e:
+            import traceback
 
-
-def worker_config(name: str, platform: str) -> None:
-    fn, _budget = CONFIGS[name]
-    jax, devices, mesh = setup_backend(platform)
-    t0 = time.perf_counter()
-    detail = fn(jax, mesh, platform)
-    detail.update({
-        "name": name, "platform": platform,
-        "device_kind": devices[0].device_kind,
-        "total_s": round(time.perf_counter() - t0, 2),
-    })
-    base, elapsed = detail.get("baseline_s"), detail.get("elapsed_s")
-    if base and elapsed:
-        detail["speedup"] = round(base / elapsed, 2)
-    peak = peak_flops(devices[0].device_kind)
-    if peak and detail.get("model_flops") and elapsed:
-        detail["mfu"] = round(detail["model_flops"] / elapsed / peak, 5)
-    detail.pop("model_flops", None)
-    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+            traceback.print_exc()
+            print("CONFIG_FAILED " + json.dumps(
+                {"name": name, "error": repr(e)}), flush=True)
+            continue
+        detail.update({
+            "name": name, "platform": platform,
+            "device_kind": devices[0].device_kind,
+            "total_s": round(time.perf_counter() - t0, 2),
+        })
+        print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+    hb("worker done")
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # skip interpreter/PJRT teardown: a wedged tunnel client must not
+    # hang the exit (the orchestrator treats EOF as clean shutdown)
+    os._exit(0)
 
 
 # ---------------------------------------------------------------------------
 # Orchestrator (no jax in this process)
 # ---------------------------------------------------------------------------
 
-def _last_json(out: str):
-    """Parse the last JSON-looking line of worker stdout; None on any
-    malformed/truncated output (a killed worker must never crash the
-    orchestrator's collection loop)."""
-    for line in reversed((out or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                return None
-    return None
+class WorkerHandle:
+    """A worker subprocess + reader threads. stdout lines land in a
+    queue; stderr lines are echoed to our stderr and kept (tail) for
+    failure forensics."""
 
+    def __init__(self, args):
+        import queue
 
-def _run_sub(args, timeout):
-    """Run a worker subprocess; (rc, stdout, stderr_tail). rc=124 on
-    timeout — the subprocess is killed, the suite lives on."""
-    try:
-        p = subprocess.run(
+        self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)] + args,
-            capture_output=True, text=True, timeout=timeout)
-        return p.returncode, p.stdout, p.stderr[-2000:]
-    except subprocess.TimeoutExpired as e:
-        out = e.stdout or b""
-        if isinstance(out, bytes):
-            out = out.decode(errors="replace")
-        return 124, out, f"timeout after {timeout}s"
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, bufsize=1)
+        self.lines: "queue.Queue[str]" = queue.Queue()
+        self.err_tail = []
+        threading.Thread(target=self._pump_out, daemon=True).start()
+        threading.Thread(target=self._pump_err, daemon=True).start()
+
+    def _pump_out(self):
+        for line in self.proc.stdout:
+            self.lines.put(line.rstrip("\n"))
+        self.lines.put("__EOF__")
+
+    def _pump_err(self):
+        for line in self.proc.stderr:
+            line = line.rstrip("\n")
+            print(f"  | {line}", file=sys.stderr, flush=True)
+            self.err_tail.append(line)
+            del self.err_tail[:-40]
+
+    def send(self, line: str) -> bool:
+        try:
+            self.proc.stdin.write(line + "\n")
+            self.proc.stdin.flush()
+            return True
+        except (BrokenPipeError, OSError, ValueError):
+            return False
+
+    def read_until(self, prefixes, deadline):
+        """Next line starting with any prefix, or None on timeout/EOF."""
+        import queue
+
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return None
+            try:
+                line = self.lines.get(timeout=min(remain, 5.0))
+            except queue.Empty:
+                continue
+            if line == "__EOF__":
+                return None
+            for p in prefixes:
+                if line.startswith(p):
+                    return line
+
+    def kill(self):
+        try:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        except Exception:
+            pass
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
 
 
-def resolve_platform():
-    """BENCH_PLATFORM override, else probe the env-configured platform
-    (the real chip) with retries + backoff, else CPU."""
+def resolve_platform() -> str:
     override = os.environ.get("BENCH_PLATFORM")
     if override:
-        log(f"[bench] platform forced to {override} via BENCH_PLATFORM")
-        rc, out, err = _run_sub(["--probe", override], timeout=420)
-        if rc == 0:
-            return override, _last_json(out)
-        log(f"[bench] forced platform {override} probe FAILED (rc={rc}) — "
-            "falling back to CPU")
-        return "cpu", None
-
+        log(f"platform forced to {override} via BENCH_PLATFORM")
+        return override
     plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() or "tpu"
-    plat = None if plat == "cpu" else plat
+    return plat
 
-    if plat:
-        for attempt, budget in enumerate((240, 240, 360)):
-            rc, out, err = _run_sub(["--probe", plat], timeout=budget)
-            info = _last_json(out) if rc == 0 else None
-            if info:
-                log(f"[bench] platform {plat} up: "
-                    f"{info['n_devices']} x {info['device_kind']}")
-                return plat, info
-            log(f"[bench] probe {plat} attempt {attempt + 1} failed "
-                f"(rc={rc}): {err.strip().splitlines()[-1] if err.strip() else 'no output'}")
-            time.sleep(10 * (attempt + 1))
-    log("[bench] no accelerator reachable — falling back to CPU")
-    rc, out, err = _run_sub(["--probe", "cpu"], timeout=240)
-    return "cpu", (_last_json(out) if rc == 0 else None)
+
+class Suite:
+    def __init__(self, names, deadline_s):
+        self.names = names
+        self.deadline = time.monotonic() + deadline_s
+        self.details = []
+        self.failures = []
+        self.baselines = {}
+        self.devinfo = {}
+        self.done = set()
+        self._emitted = False
+
+    # -- workers ------------------------------------------------------------
+
+    def start_worker(self, platform):
+        w = WorkerHandle(["--worker", "--platform", platform])
+        line = w.read_until(
+            ("DEVINFO",),
+            min(self.deadline - 30, time.monotonic() + INIT_BUDGET_S))
+        if line is None:
+            tail = w.err_tail[-3:]
+            log(f"worker init on {platform} FAILED/hung "
+                f"(last heartbeats: {tail})")
+            w.kill()
+            return None
+        self.devinfo = json.loads(line[len("DEVINFO "):])
+        log(f"worker up: {self.devinfo['n_devices']} x "
+            f"{self.devinfo['device_kind']}")
+        return w
+
+    def run_config(self, w: WorkerHandle, name: str) -> bool:
+        """True if the config produced a detail (or a clean in-worker
+        failure); False if the worker must be presumed wedged."""
+        _fn, budget = CONFIGS[name]
+        deadline = min(self.deadline - 30, time.monotonic() + budget)
+        if deadline - time.monotonic() < 10:
+            self.failures.append({"name": name, "error": "suite deadline"})
+            log(f"{name}: SKIPPED (deadline)")
+            self.done.add(name)
+            return True
+        if not w.send(name):
+            return False
+        line = w.read_until(("BENCH_DETAIL", "CONFIG_FAILED"), deadline)
+        if line is None:
+            self.failures.append({
+                "name": name, "error": "timeout/worker-death",
+                "last_heartbeats": w.err_tail[-5:]})
+            log(f"{name}: TIMEOUT (last heartbeats: {w.err_tail[-3:]})")
+            return False
+        if line.startswith("CONFIG_FAILED"):
+            info = json.loads(line[len("CONFIG_FAILED "):])
+            self.failures.append(info)
+            log(f"{name}: FAILED in-worker ({info.get('error')})")
+            self.done.add(name)
+            return True
+        detail = json.loads(line[len("BENCH_DETAIL "):])
+        self.finish_detail(detail)
+        self.done.add(name)
+        return True
+
+    def finish_detail(self, detail):
+        name = detail["name"]
+        # a success supersedes earlier timeout entries for the same config
+        # (a retry on a fresh worker after a wedge) — the artifact must
+        # not report a config as both failed and measured
+        self.failures = [f for f in self.failures if f.get("name") != name]
+        base = self.baselines.get(name, {})
+        # never clobber a baseline the worker measured itself (the scaled
+        # CPU ml20m run carries its own matched baseline)
+        detail.update({k: v for k, v in base.items()
+                       if k != "name" and k not in detail})
+        b, e = detail.get("baseline_s"), detail.get("elapsed_s")
+        if b and e:
+            detail["speedup"] = round(b / e, 2)
+        peak = peak_flops(detail.get("device_kind", ""))
+        if peak and detail.get("model_flops") and e:
+            detail["mfu"] = round(detail["model_flops"] / e / peak, 5)
+        detail.pop("model_flops", None)
+        self.details.append(detail)
+        log(f"{name}: {json.dumps(detail)}")
+
+    # -- final output -------------------------------------------------------
+
+    def emit(self):
+        if self._emitted:        # SIGTERM during normal emit: print once
+            return
+        self._emitted = True
+        total = sum(d.get("elapsed_s") or 0.0 for d in self.details)
+        speedups = [d["speedup"] for d in self.details if d.get("speedup")]
+        geomean = (float(np.exp(np.mean(np.log(speedups))))
+                   if speedups else 0.0)
+        mfus = {d["name"]: d["mfu"] for d in self.details if d.get("mfu")}
+        pipeline = next(
+            (d for d in self.details if d["name"] == "pipeline_ml100k"),
+            None)
+        per_cfg = ", ".join(
+            f"{d['name']} {d.get('speedup', '-')}x"
+            + (f"/mfu {d['mfu']:.1%}" if d.get("mfu") else "")
+            for d in self.details)
+        # label with the device(s) the details ACTUALLY ran on — a
+        # mid-suite TPU->CPU fallback must not mislabel the TPU numbers
+        kinds = sorted({d.get("device_kind", "?") for d in self.details})
+        unit = (f"seconds total across {len(self.details)}/"
+                f"{len(self.names)} configs on "
+                f"{' + '.join(kinds) if kinds else '?'}; "
+                f"speedups [{per_cfg}]")
+        if pipeline:
+            unit += (f"; pio-train {pipeline['train_s']}s "
+                     f"(warm {pipeline.get('train_warm_s', '?')}s), query "
+                     f"p50 {pipeline['query_p50_ms']}ms p99 "
+                     f"{pipeline['query_p99_ms']}ms")
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_DETAILS.json"), "w") as f:
+                json.dump({"devinfo": self.devinfo, "details": self.details,
+                           "failures": self.failures, "mfu": mfus,
+                           "baselines": self.baselines}, f, indent=1)
+        except OSError:
+            pass
+        print(json.dumps({
+            "metric": "judged_suite_wallclock",
+            "value": round(total, 3),
+            "unit": unit,
+            "vs_baseline": round(geomean, 2),
+        }), flush=True)
+
+
+def orchestrate(names):
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 1500))
+    suite = Suite(names, deadline_s)
+
+    def _sigterm(_sig, _frm):
+        log("SIGTERM — dumping partial results")
+        suite.emit()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    # baselines measure in parallel with the worker's TPU claim (pure
+    # numpy process vs a process that waits on the relay — overlap is
+    # nearly free, and on the cpu fallback the claim is instant so the
+    # overlap window is tiny)
+    base_proc = WorkerHandle(["--baselines", ",".join(
+        n for n in names if n in BASELINES)])
+
+    platform = resolve_platform()
+    worker = None
+    attempts = 0
+    if platform != "cpu":
+        worker = suite.start_worker(platform)
+        if worker is None:
+            attempts += 1
+            log(f"retrying {platform} worker once")
+            worker = suite.start_worker(platform)
+    if worker is None:
+        platform = "cpu"
+        worker = suite.start_worker("cpu")
+        if worker is None:
+            log("even the CPU worker failed to start")
+            suite.emit()
+            return
+
+    # drain baselines (they are much faster than the claim; give slack)
+    base_deadline = min(suite.deadline,
+                        time.monotonic() + 600)
+    while True:
+        line = base_proc.read_until(("BASELINE", "BASELINES_DONE"),
+                                    base_deadline)
+        if line is None or line == "BASELINES_DONE":
+            break
+        info = json.loads(line[len("BASELINE "):])
+        suite.baselines[info["name"]] = info
+    base_proc.kill()
+    log(f"baselines measured: {sorted(suite.baselines)}")
+
+    def replace_wedged_worker(old):
+        """Kill a wedged worker and ladder down: one accelerator respawn,
+        then CPU. Returns the replacement (None = nothing startable)."""
+        nonlocal platform, attempts
+        old.kill()
+        if platform != "cpu":
+            if attempts < 1:
+                attempts += 1
+                log("respawning worker after wedge")
+                nxt = suite.start_worker(platform)
+                if nxt is not None:
+                    return nxt
+            platform = "cpu"
+        return suite.start_worker("cpu")
+
+    pending = list(names)
+    while pending:
+        name = pending.pop(0)
+        retried = False
+        while name not in suite.done:
+            if worker is None or not suite.run_config(worker, name):
+                if worker is not None:
+                    worker = replace_wedged_worker(worker)
+                if worker is None or retried:
+                    # a config that wedged two workers (or no worker at
+                    # all) is marked failed; run_config already recorded
+                    # the timeout, so just move on
+                    suite.done.add(name)
+                    if worker is None:
+                        for n in pending:
+                            suite.failures.append(
+                                {"name": n, "error": "no worker available"})
+                        pending = []
+                    break
+                retried = True    # ONE more chance on the fresh worker
+            # run_config marked it done (success or clean in-worker fail)
+
+    if worker is not None:
+        worker.send("QUIT")
+        time.sleep(1)
+        worker.kill()
+    suite.emit()
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--probe")
-    ap.add_argument("--config")
+    ap.add_argument("--worker", action="store_true",
+                    help="jax worker: claims the device, runs configs "
+                         "fed over stdin")
+    ap.add_argument("--baselines", help="comma-separated baseline subset "
+                                        "(no-jax numpy worker)")
+    ap.add_argument("--config", help="single-shot: run one config and exit "
+                                     "(debugging)")
     ap.add_argument("--platform", default="cpu")
     ap.add_argument("--only", help="comma-separated config subset")
     args = ap.parse_args()
 
-    if args.probe:
-        worker_probe(args.probe)
+    if args.worker:
+        worker_loop(args.platform)
+        return
+    if args.baselines is not None:
+        worker_baselines([n for n in args.baselines.split(",") if n])
         return
     if args.config:
-        worker_config(args.config, args.platform)
-        return
-
-    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE_S",
-                                                       5400))
-    platform, _info = resolve_platform()
+        jax, devices, mesh = setup_backend(args.platform)
+        detail = CONFIGS[args.config][0](jax, mesh, args.platform)
+        print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+        os._exit(0)
 
     names = list(CONFIGS)
     if args.only:
         names = args.only.split(",")
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
-            log(f"[bench] unknown config(s) {unknown}; "
-                f"known: {list(CONFIGS)}")
+            log(f"unknown config(s) {unknown}; known: {list(CONFIGS)}")
             sys.exit(2)
-
-    details, failures = [], []
-    for name in names:
-        _fn, budget = CONFIGS[name]
-        remain = deadline - time.monotonic()
-        if remain < 60:
-            failures.append({"name": name, "error": "suite deadline hit"})
-            log(f"[bench] {name}: SKIPPED (deadline)")
-            continue
-        rc, out, err = _run_sub(
-            ["--config", name, "--platform", platform],
-            timeout=min(budget, remain))
-        detail = None
-        for line in out.splitlines():
-            if line.startswith("BENCH_DETAIL "):
-                try:
-                    detail = json.loads(line[len("BENCH_DETAIL "):])
-                except json.JSONDecodeError:
-                    pass          # truncated line from a killed worker
-        if rc == 0 and detail:
-            details.append(detail)
-            log(f"[bench] {name}: {json.dumps(detail)}")
-        else:
-            tail = (err or out).strip().splitlines()
-            failures.append({"name": name, "rc": rc,
-                             "error": tail[-1] if tail else "no output"})
-            log(f"[bench] {name}: FAILED rc={rc} "
-                f"({tail[-1] if tail else 'no output'})")
-
-    total = sum(d.get("elapsed_s") or 0.0 for d in details)
-    speedups = [d["speedup"] for d in details if d.get("speedup")]
-    geomean = (float(np.exp(np.mean(np.log(speedups))))
-               if speedups else 0.0)
-    mfus = {d["name"]: d["mfu"] for d in details if d.get("mfu")}
-    pipeline = next((d for d in details if d["name"] == "pipeline_ml100k"),
-                    None)
-
-    per_cfg = ", ".join(
-        f"{d['name']} {d.get('speedup', '-')}x"
-        + (f"/mfu {d['mfu']:.1%}" if d.get("mfu") else "")
-        for d in details)
-    unit = (f"seconds total across {len(details)}/{len(names)} configs on "
-            f"{platform}; speedups [{per_cfg}]")
-    if pipeline:
-        unit += (f"; pio-train {pipeline['train_s']}s, query p50 "
-                 f"{pipeline['query_p50_ms']}ms p99 "
-                 f"{pipeline['query_p99_ms']}ms")
-
-    # full per-config artifact for the judge
-    try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_DETAILS.json"), "w") as f:
-            json.dump({"platform": platform, "details": details,
-                       "failures": failures, "mfu": mfus}, f, indent=1)
-    except OSError:
-        pass
-
-    print(json.dumps({
-        "metric": "judged_suite_wallclock",
-        "value": round(total, 3),
-        "unit": unit,
-        "vs_baseline": round(geomean, 2),
-    }))
+    orchestrate(names)
 
 
 if __name__ == "__main__":

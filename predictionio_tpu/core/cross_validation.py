@@ -1,0 +1,34 @@
+"""Shared k-fold cross-validation helpers.
+
+The analog of the reference's e2 CommonHelperFunctions.splitData
+(e2/src/main/scala/org/apache/predictionio/e2/evaluation/
+CrossValidation.scala:36): fold membership by index modulo, shared by
+every engine's readEval instead of hand-rolled per template.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple, TypeVar
+
+import numpy as np
+
+T = TypeVar("T")
+
+
+def split_data(k: int, n: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield (train_indices, test_indices) per fold for n data points,
+    fold membership = index mod k (CrossValidation.scala:36 parity)."""
+    if k < 1:
+        raise ValueError(f"kFold must be >= 1, got {k}")
+    idx = np.arange(n)
+    for fold in range(k):
+        test = idx[idx % k == fold]
+        train = idx[idx % k != fold]
+        yield train, test
+
+
+def k_fold(items: Sequence[T], k: int) -> Iterator[Tuple[List[T], List[T]]]:
+    """Yield (train_items, test_items) per fold over a concrete sequence."""
+    for train_idx, test_idx in split_data(k, len(items)):
+        yield ([items[i] for i in train_idx],
+               [items[i] for i in test_idx])

@@ -97,12 +97,12 @@ class ClassificationDataSource(DataSource):
         if not self.params.eval_k:
             raise ValueError("DataSourceParams.eval_k must not be None "
                              "(DataSource.scala:77 require parity)")
+        from predictionio_tpu.core.cross_validation import k_fold
+
         k = self.params.eval_k
         points = self._points()
         folds = []
-        for fold in range(k):
-            train = [p for i, p in enumerate(points) if i % k != fold]
-            test = [p for i, p in enumerate(points) if i % k == fold]
+        for train, test in k_fold(points, k):
             qa = [(Query(*p.features), ActualResult(label=p.label))
                   for p in test]
             folds.append((TrainingData(points=train), None, qa))
@@ -148,8 +148,11 @@ class NaiveBayesAlgorithm(Algorithm):
     def train(self, ctx, pd: PreparedData) -> MultinomialNBModel:
         if not pd.points:
             raise ValueError("no labeled points; import training data first")
+        from predictionio_tpu.workflow.context import mesh_of
+
         X, y = _xy(pd)
-        return train_multinomial_nb(X, y, smoothing=self.params.reg)
+        return train_multinomial_nb(X, y, smoothing=self.params.reg,
+                                    mesh=mesh_of(ctx))
 
     def predict(self, model: MultinomialNBModel, query: Query
                 ) -> PredictedResult:
@@ -177,11 +180,14 @@ class LogisticRegressionAlgorithm(Algorithm):
     def train(self, ctx, pd: PreparedData) -> LogRegModel:
         if not pd.points:
             raise ValueError("no labeled points; import training data first")
+        from predictionio_tpu.workflow.context import mesh_of
+
         X, y = _xy(pd)
         return train_logreg(X, y, LogRegParams(
             iterations=self.params.iterations,
             learning_rate=self.params.learning_rate,
-            reg=self.params.reg, seed=self.params.seed))
+            reg=self.params.reg, seed=self.params.seed),
+            mesh=mesh_of(ctx))
 
     def predict(self, model: LogRegModel, query: Query) -> PredictedResult:
         x = np.asarray([[query.attr0, query.attr1, query.attr2]], np.float32)
@@ -209,8 +215,10 @@ class RandomForestAlgorithm(Algorithm):
     def train(self, ctx, pd: PreparedData) -> ForestModel:
         if not pd.points:
             raise ValueError("no labeled points; import training data first")
+        from predictionio_tpu.workflow.context import mesh_of
+
         X, y = _xy(pd)
-        return train_forest(X, y, self.params)
+        return train_forest(X, y, self.params, mesh=mesh_of(ctx))
 
     def predict(self, model: ForestModel, query: Query) -> PredictedResult:
         x = np.asarray([[query.attr0, query.attr1, query.attr2]], np.float32)

@@ -140,15 +140,15 @@ class RecommendationDataSource(DataSource):
         return TrainingData(ratings=self._read_ratings())
 
     def read_eval(self, ctx):
-        """K-fold split by index modulo (DataSource.scala:87-120, the
-        e2 CommonHelperFunctions.splitData pattern)."""
+        """K-fold split via the shared helper (DataSource.scala:87-120 /
+        e2 CommonHelperFunctions.splitData, core/cross_validation.py)."""
+        from predictionio_tpu.core.cross_validation import k_fold
+
         ep = self.params.eval_params or {}
         k = int(ep.get("kFold", 3))
         ratings = self._read_ratings()
         folds = []
-        for fold in range(k):
-            train = [r for i, r in enumerate(ratings) if i % k != fold]
-            test = [r for i, r in enumerate(ratings) if i % k == fold]
+        for fold, (train, test) in enumerate(k_fold(ratings, k)):
             qa = [(Query(user=r.user, num=int(ep.get("queryNum", 10))),
                    ActualResult(ratings=[r]))
                   for r in test]

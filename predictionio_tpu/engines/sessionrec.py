@@ -105,14 +105,18 @@ class SessionDataSource(DataSource):
     def read_eval(self, ctx):
         """Leave-one-out per session, k-fold over users (the SASRec eval
         protocol mapped onto readEval's fold contract)."""
+        from predictionio_tpu.core.cross_validation import split_data
+
         ep = self.params.eval_params or {}
         k = int(ep.get("kFold", 3))
         sessions = [s for s in self._read_sessions() if len(s) >= 3]
         folds = []
-        for fold in range(k):
+        for fold, (_train_idx, test_idx) in enumerate(
+                split_data(k, len(sessions))):
+            held_out = set(test_idx.tolist())
             train, qa = [], []
             for i, s in enumerate(sessions):
-                if i % k == fold:
+                if i in held_out:
                     qa.append((Query(items=s[:-1],
                                      num=int(ep.get("queryNum", 10))),
                                ActualResult(item=s[-1])))

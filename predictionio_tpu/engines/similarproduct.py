@@ -276,9 +276,11 @@ class CooccurrenceAlgorithm(Algorithm):
         items = np.asarray([v.item for v in pd.view_events], dtype=object)
         user_vocab, user_codes = assign_indices(users)
         item_vocab, item_codes = assign_indices(items)
+        from predictionio_tpu.workflow.context import mesh_of
+
         top = train_cooccurrence(user_codes, item_codes,
                                  len(user_vocab), len(item_vocab),
-                                 self.params.n)
+                                 self.params.n, mesh=mesh_of(ctx))
         model = CooccurrenceModel(item_vocab=item_vocab,
                                   top_cooccurrences=top)
         item_meta = {}

@@ -6,11 +6,23 @@ src/main/scala/CooccurrenceAlgorithm.scala:71-105): distinct (user, item)
 pairs -> per-item-pair counts -> top-N per item.
 
 Design: counting cooccurrences is C = A^T A for the binary user x item
-interaction matrix. When the dense A fits a memory budget the count becomes
-ONE bf16-friendly MXU matmul (ML-1M: [6040, 3706] -> 8e10 MACs, milliseconds
-on a v5e chip, vs a shuffle-heavy Spark join). Larger item spaces fall back
-to vectorized host counting over sorted per-user pair enumeration (the same
-work the Spark join materializes, without the shuffle).
+interaction matrix. When the dense A fits a memory budget:
+
+* A is scattered on the HOST (numpy fancy indexing — microseconds; the
+  r2 version used XLA `.at[u,i].set` and lost to numpy 0.59x because a
+  big one-hot scatter is a terrible XLA op) and shipped as bf16 (0 and 1
+  are exact in bf16; products accumulate in f32, exact below 2^24).
+* C's ROW BLOCKS are sharded over the mesh's "data" axis via shard_map:
+  device d computes C[block_d, :] = A[:, block_d]^T @ A as one bf16 MXU
+  matmul and immediately reduces it to a per-row top-N — the full
+  [n_items, n_items] count matrix never materializes in one device's
+  HBM, and the only collective is the all-gather of the [n_items, k]
+  result (SURVEY.md §2.9 P1/P4: the Spark self-join becomes a sharded
+  matmul + top-k).
+
+Larger item spaces fall back to vectorized host counting over sorted
+per-user pair enumeration (the same work the Spark join materializes,
+without the shuffle).
 """
 
 from __future__ import annotations
@@ -35,21 +47,103 @@ def distinct_pairs(user_idx: np.ndarray, item_idx: np.ndarray
     return user_idx[keep], item_idx[keep]
 
 
-def cooccurrence_counts_dense(user_idx: np.ndarray, item_idx: np.ndarray,
-                              n_users: int, n_items: int) -> np.ndarray:
-    """C = A^T A on device — the MXU path. Returns [n_items, n_items] with
-    the diagonal zeroed."""
+def cooccurrence_topn(mesh, user_idx: np.ndarray, item_idx: np.ndarray,
+                      n_users: int, n_items: int, n_top: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-N cooccurrence (counts [n_items, k], item idx [n_items, k])
+    via the sharded MXU matmul described in the module docstring. Rows
+    with fewer than k nonzero cooccurrents pad with count 0 (filter on
+    count > 0). k = min(n_top, n_items)."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    axis = mesh.axis_names[0]
+    k = int(min(n_top, n_items))
+
+    if n_dev == 1 and jax.default_backend() == "cpu":
+        # single-device CPU fallback: BLAS syrk exploits the symmetry of
+        # A^T A (half the FLOPs); XLA lowers it to a generic gemm and
+        # loses 2x. The dispatch-aware backend pick mirrors the serving
+        # path (models/als.py _use_host).
+        from predictionio_tpu.ops.topk import host_topk
+
+        a = np.zeros((n_users, n_items), np.float32)
+        a[user_idx, item_idx] = 1.0
+        c = a.T @ a
+        np.fill_diagonal(c, 0.0)
+        return host_topk(c, k)
+
+    # pad items to a multiple of 128 lanes x device count: zero columns
+    # count nothing and padded rows are sliced off after the gather
+    blk = -(-n_items // (128 * n_dev)) * 128
+    ni_pad = blk * n_dev
+
+    a = np.zeros((n_users, ni_pad), np.float32)
+    a[user_idx, item_idx] = 1.0
+    if jax.default_backend() == "tpu":
+        a = a.astype(jnp.bfloat16)      # exact for 0/1; halves the upload;
+        # f32 elsewhere: CPU XLA emulates bf16 matmuls slowly
+
+    run = _sharded_topn_fn(mesh, axis, n_dev, blk, ni_pad, k)
+    a_dev = jax.device_put(a, NamedSharding(mesh, P(None, axis)))
+    vals, idx = jax.device_get(run(a_dev))
+    return np.asarray(vals)[:n_items], np.asarray(idx)[:n_items]
+
+
+#: compiled sharded count+topk fns, keyed on everything that shapes the
+#: program — rebuilding the jit wrapper per call would re-trace and
+#: re-compile every time (eval sweeps train cooccurrence once per fold)
+_TOPN_FN_CACHE: "OrderedDict" = None
+_TOPN_FN_CACHE_MAX = 8
+
+
+def _sharded_topn_fn(mesh, axis: str, n_dev: int, blk: int, ni_pad: int,
+                     k: int):
+    global _TOPN_FN_CACHE
+    from collections import OrderedDict
+
+    if _TOPN_FN_CACHE is None:
+        _TOPN_FN_CACHE = OrderedDict()
+    key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
+           axis, blk, ni_pad, k)
+    fn = _TOPN_FN_CACHE.get(key)
+    if fn is not None:
+        _TOPN_FN_CACHE.move_to_end(key)
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def block(a_cols, a_full):
+        # a_cols [nu, blk] — this device's item block; a_full replicated
+        c = jnp.dot(a_cols.T, a_full,
+                    preferred_element_type=jnp.float32)   # [blk, ni_pad]
+        row0 = jax.lax.axis_index(axis) * blk
+        rows = row0 + jnp.arange(blk)[:, None]
+        cols = jnp.arange(ni_pad)[None, :]
+        c = jnp.where(rows == cols, 0.0, c)               # zero diagonal
+        vals, idx = jax.lax.top_k(c, k)
+        return vals[None], idx[None]
+
+    sharded = shard_map(
+        block, mesh=mesh,
+        in_specs=(P(None, axis), P()),
+        out_specs=(P(axis, None, None), P(axis, None, None)),
+        check_vma=False)
 
     @jax.jit
-    def count(u, i):
-        a = jnp.zeros((n_users, n_items), jnp.float32).at[u, i].set(1.0)
-        c = a.T @ a
-        return c * (1.0 - jnp.eye(n_items, dtype=jnp.float32))
+    def run(a_dev):
+        vals, idx = sharded(a_dev, a_dev)
+        return (vals.reshape(ni_pad, k), idx.reshape(ni_pad, k))
 
-    return np.asarray(jax.device_get(count(jnp.asarray(user_idx),
-                                           jnp.asarray(item_idx))))
+    _TOPN_FN_CACHE[key] = run
+    while len(_TOPN_FN_CACHE) > _TOPN_FN_CACHE_MAX:
+        _TOPN_FN_CACHE.popitem(last=False)
+    return run
 
 
 def cooccurrence_topn_host(user_idx: np.ndarray, item_idx: np.ndarray,
@@ -79,24 +173,31 @@ def cooccurrence_topn_host(user_idx: np.ndarray, item_idx: np.ndarray,
 
 
 def train_cooccurrence(user_idx: np.ndarray, item_idx: np.ndarray,
-                       n_users: int, n_items: int, n: int
+                       n_users: int, n_items: int, n: int, mesh=None
                        ) -> Dict[int, List[Tuple[int, int]]]:
-    """Top-N cooccurring (item, count) per item (trainCooccurrence parity)."""
+    """Top-N cooccurring (item, count) per item (trainCooccurrence parity).
+
+    With a mesh, C's row blocks spread over its first axis; without one,
+    a single-device mesh on the default backend."""
     if len(user_idx) == 0:
         return {}
     user_idx, item_idx = distinct_pairs(user_idx, item_idx)
     # both the [n_users, n_items] interaction matrix AND the
     # [n_items, n_items] count matrix must fit the budget
     if max(n_users * n_items, n_items * n_items) <= DENSE_BUDGET:
-        counts = cooccurrence_counts_dense(user_idx, item_idx, n_users, n_items)
+        if mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.asarray(jax.devices())[:1], axis_names=("data",))
+        vals, idx = cooccurrence_topn(mesh, user_idx, item_idx,
+                                      n_users, n_items, n)
         top: Dict[int, List[Tuple[int, int]]] = {}
-        k = min(n, max(n_items - 1, 1))
-        idx = np.argpartition(-counts, kth=k - 1, axis=1)[:, :k]
         for item in range(n_items):
-            cands = [(int(j), int(counts[item, j])) for j in idx[item]
-                     if counts[item, j] > 0]
+            cands = [(int(j), int(c)) for j, c in zip(idx[item], vals[item])
+                     if c > 0]
             if cands:
-                top[item] = sorted(cands, key=lambda x: -x[1])[:n]
+                top[item] = cands       # top_k output is already sorted desc
         return top
     return cooccurrence_topn_host(user_idx, item_idx, n_items, n)
 

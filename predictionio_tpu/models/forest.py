@@ -161,6 +161,36 @@ def _fit_forest(bins, labels, boot_idx, feat_mask, n_classes, max_depth,
     )(boot_idx, feat_mask)
 
 
+#: compiled tree-sharded fit fns keyed on mesh + static hyperparams — a
+#: per-call jit(shard_map(...)) wrapper would re-trace every fold of a
+#: cross-validated eval (jit's cache keys on function identity)
+_SHARDED_FIT_CACHE: dict = {}
+
+
+def _sharded_fit_fn(mesh, c: int, depth: int, b: int, impurity: str):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
+           axis, c, depth, b, impurity)
+    fn = _SHARDED_FIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(shard_map(
+            lambda xqd, cd, bi, fm: jax.vmap(
+                lambda one_b, one_m: _fit_kernel(
+                    xqd, cd, one_b, one_m, c, depth, b, impurity)
+            )(bi, fm),
+            mesh=mesh,
+            in_specs=(P(), P(), P(axis, None), P(axis, None, None)),
+            out_specs=(P(axis, None), P(axis, None), P(axis, None)),
+            check_vma=False))
+        _SHARDED_FIT_CACHE[key] = fn
+        while len(_SHARDED_FIT_CACHE) > 8:
+            _SHARDED_FIT_CACHE.pop(next(iter(_SHARDED_FIT_CACHE)))
+    return fn
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_classes"))
 def _predict_kernel(feat, thr, leaf, qbins, max_depth, n_classes):
     """feat/thr [T, M], leaf [T, 2^D], qbins [N, F] -> votes argmax [N]."""
@@ -211,9 +241,16 @@ class ForestModel:
         return self.classes[np.asarray(codes)]
 
 
-def train_forest(X: np.ndarray, y: Sequence, params: ForestParams
-                 ) -> ForestModel:
-    """Fit a forest on dense [N, F] features with arbitrary labels."""
+def train_forest(X: np.ndarray, y: Sequence, params: ForestParams,
+                 mesh=None) -> ForestModel:
+    """Fit a forest on dense [N, F] features with arbitrary labels.
+
+    With a multi-device `mesh`, TREES shard over its first axis (the
+    embarrassingly-parallel axis MLlib also exploits per-tree): each
+    device grows its tree subset on replicated binned data, no cross-
+    device traffic until the per-tree node arrays gather at the end.
+    num_trees pads up to a device-count multiple (extra trees only
+    sharpen the vote)."""
     X = np.asarray(X, np.float32)
     n, f = X.shape
     classes, codes = np.unique(np.asarray(y), return_inverse=True)
@@ -230,6 +267,9 @@ def train_forest(X: np.ndarray, y: Sequence, params: ForestParams
         xq[:, j] = np.searchsorted(thresholds[j], X[:, j], side="left")
 
     t = int(params.num_trees)
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    if n_dev > 1:
+        t += (-t) % n_dev
     depth = int(params.max_depth)
     rng = np.random.default_rng(params.seed)
     boot = rng.integers(0, n, size=(t, n)).astype(np.int32)
@@ -243,10 +283,16 @@ def train_forest(X: np.ndarray, y: Sequence, params: ForestParams
         kth = np.partition(scores, m - 1, axis=-1)[..., m - 1:m]
         mask = scores <= kth
 
-    feat, thr, leaf = _fit_forest(
-        jnp.asarray(xq), jnp.asarray(codes.astype(np.int32)),
-        jnp.asarray(boot), jnp.asarray(mask), c, depth, b,
-        params.impurity)
+    if n_dev > 1:
+        fit = _sharded_fit_fn(mesh, c, depth, b, params.impurity)
+        feat, thr, leaf = fit(
+            jnp.asarray(xq), jnp.asarray(codes.astype(np.int32)),
+            jnp.asarray(boot), jnp.asarray(mask))
+    else:
+        feat, thr, leaf = _fit_forest(
+            jnp.asarray(xq), jnp.asarray(codes.astype(np.int32)),
+            jnp.asarray(boot), jnp.asarray(mask), c, depth, b,
+            params.impurity)
     return ForestModel(
         classes=classes, thresholds=thresholds,
         feat=np.asarray(feat), thr=np.asarray(thr),

@@ -37,7 +37,13 @@ class LogRegModel:
 
 
 def train_logreg(X: np.ndarray, labels: Sequence[str],
-                 params: LogRegParams = LogRegParams()) -> LogRegModel:
+                 params: LogRegParams = LogRegParams(),
+                 mesh=None) -> LogRegModel:
+    """With a multi-device `mesh`, example rows shard over its first axis
+    (NamedSharding) and XLA's SPMD partitioner inserts the gradient psum —
+    data-parallel training in the collective-over-ICI style of SURVEY §2.9
+    P1 (replacing MLlib LogisticRegression's Spark aggregation). Padded
+    rows carry weight 0 so the masked mean is shard-count invariant."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -46,13 +52,32 @@ def train_logreg(X: np.ndarray, labels: Sequence[str],
     label_vocab, y = np.unique(labels, return_inverse=True)
     n_features, n_labels = X.shape[1], len(label_vocab)
 
-    Xd = jnp.asarray(X, jnp.float32)
-    yd = jnp.asarray(y, jnp.int32)
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    pad = (-len(y)) % n_dev
+    Xp = np.concatenate([X, np.zeros((pad, n_features), X.dtype)]) \
+        if pad else X
+    yp = np.concatenate([y, np.zeros(pad, y.dtype)]) if pad else y
+    wts = np.concatenate([np.ones(len(y), np.float32),
+                          np.zeros(pad, np.float32)])
+    if mesh is not None and n_dev > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        Xd = jax.device_put(np.asarray(Xp, np.float32),
+                            NamedSharding(mesh, P(axis, None)))
+        yd = jax.device_put(np.asarray(yp, np.int32),
+                            NamedSharding(mesh, P(axis)))
+        wd = jax.device_put(wts, NamedSharding(mesh, P(axis)))
+    else:
+        Xd = jnp.asarray(Xp, jnp.float32)
+        yd = jnp.asarray(yp, jnp.int32)
+        wd = jnp.asarray(wts)
 
     def loss_fn(w_b):
         W, b = w_b
         logits = Xd @ W + b
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, yd).mean()
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, yd)
+        ce = (ce * wd).sum() / wd.sum()
         return ce + params.reg * (W * W).sum()
 
     opt = optax.adam(params.learning_rate)

@@ -101,6 +101,39 @@ def train_categorical_nb(points: Sequence[LabeledPoint]
 # Multinomial NB (MLlib analog)
 # ---------------------------------------------------------------------------
 
+#: inputs below this element count train on host (np.add.at) — the device
+#: (or sharded-device) count matmul can't repay its transfer + dispatch
+DEVICE_MIN_SIZE = 1_000_000
+
+#: compiled sharded count fns keyed on mesh + label count (jit's cache
+#: keys on function identity, so the wrapper must be reused across calls)
+_SHARDED_COUNT_CACHE: dict = {}
+
+
+def _sharded_count_fn(mesh, axis: str, n_labels: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
+           axis, n_labels)
+    fn = _SHARDED_COUNT_CACHE.get(key)
+    if fn is None:
+        def count_block(c, x):
+            onehot = jax.nn.one_hot(c, n_labels, dtype=jnp.float32)
+            return jax.lax.psum(onehot.T @ x.astype(jnp.float32), axis)
+
+        fn = jax.jit(shard_map(
+            count_block, mesh=mesh,
+            in_specs=(P(axis), P(axis, None)),
+            out_specs=P()))
+        _SHARDED_COUNT_CACHE[key] = fn
+        while len(_SHARDED_COUNT_CACHE) > 8:
+            _SHARDED_COUNT_CACHE.pop(next(iter(_SHARDED_COUNT_CACHE)))
+    return fn
+
+
 def _compact_for_transfer(X: np.ndarray) -> np.ndarray:
     """Count matrices are usually small non-negative integers stored as
     float; ship them as uint8/uint16 (4x/2x fewer bytes over the
@@ -143,18 +176,41 @@ class MultinomialNBModel:
 
 
 def train_multinomial_nb(X: np.ndarray, labels: Sequence[str],
-                         smoothing: float = 1.0) -> MultinomialNBModel:
+                         smoothing: float = 1.0, mesh=None
+                         ) -> MultinomialNBModel:
     """MLlib NaiveBayes.train parity (lambda smoothing). Per-label feature
     counting runs as a one-hot [L,N]@[N,F] device matmul (MXU) when the
-    input is big enough to pay for the transfer."""
+    input is big enough to pay for the transfer.
+
+    With a multi-device `mesh`, documents shard over its first axis and
+    each device contributes a partial [L, F] count combined by one psum —
+    the collective analog of the reference's distributed `combineByKey`
+    (e2/.../CategoricalNaiveBayes.scala:29, SURVEY §2.9 P1)."""
     labels = np.asarray(labels, dtype=object)
     label_vocab, label_codes = np.unique(labels, return_inverse=True)
     n_labels = len(label_vocab)
     n_features = X.shape[1]
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     # device path: worth the transfer for big X, but the [N, L] one-hot it
     # materializes must stay bounded too (many-label inputs would OOM where
     # the host path needs only the [L, F] buffer)
-    if X.size >= 1_000_000 and X.shape[0] * n_labels * 4 <= 1 << 28:
+    if mesh is not None and n_dev > 1 and X.size >= DEVICE_MIN_SIZE \
+            and X.shape[0] * n_labels * 4 <= (1 << 28) * n_dev:
+        import jax
+
+        axis = mesh.axis_names[0]
+        pad = (-len(label_codes)) % n_dev
+        codes = np.concatenate(
+            [label_codes.astype(np.int32),
+             np.full(pad, -1, np.int32)]         # one_hot(-1) == zero row
+        ) if pad else label_codes.astype(np.int32)
+        Xc = _compact_for_transfer(X)
+        Xp = np.concatenate(
+            [Xc, np.zeros((pad, n_features), Xc.dtype)]) if pad else Xc
+        counts = np.asarray(jax.device_get(
+            _sharded_count_fn(mesh, axis, n_labels)(codes, Xp)
+        )).astype(np.float64)
+    elif X.size >= DEVICE_MIN_SIZE and X.shape[0] * n_labels * 4 <= 1 << 28:
         import jax
         import jax.numpy as jnp
 

@@ -296,7 +296,17 @@ def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
         # pytrees survive the restricted snapshot unpickler); rebuild it
         # against the freshly-initialized state's structure + sharding
         treedef = jax.tree.structure(opt_state)
-        if treedef.num_leaves == len(restored_opt_leaves):
+        init_leaves = jax.tree.leaves(opt_state)
+        # leaf count alone can't prove layout compatibility (round-3
+        # advisor finding): every restored leaf must also match the
+        # freshly-initialized leaf's shape AND dtype, else a snapshot
+        # from different hyperparams (or an optax layout change) would
+        # smuggle mis-shaped moments into the first apply_updates
+        compatible = treedef.num_leaves == len(restored_opt_leaves) and all(
+            np.asarray(s).shape == np.asarray(i).shape
+            and np.asarray(s).dtype == np.asarray(i).dtype
+            for s, i in zip(restored_opt_leaves, init_leaves))
+        if compatible:
             saved = jax.tree.unflatten(treedef, restored_opt_leaves)
             opt_state = jax.tree.map(
                 lambda init_leaf, s: jax.device_put(
@@ -307,9 +317,10 @@ def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
             import logging
 
             logging.getLogger(__name__).warning(
-                "seqrec snapshot optimizer state has %d leaves, current "
-                "optimizer expects %d (optax layout change?) — resuming "
-                "params at epoch %d with RESET adam moments",
+                "seqrec snapshot optimizer state incompatible with the "
+                "current optimizer layout (%d leaves saved, %d expected, "
+                "or shape/dtype mismatch) — resuming params at epoch %d "
+                "with RESET adam moments",
                 len(restored_opt_leaves), treedef.num_leaves, epoch0)
     step = make_train_step(mesh, p, optimizer)
 

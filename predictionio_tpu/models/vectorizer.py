@@ -47,11 +47,7 @@ class BinaryVectorizer:
         return out
 
 
-def split_data(k: int, n: int):
-    """K-fold index split by modulo (e2 CrossValidation.splitData:36 parity):
-    yields (train_indices, test_indices) per fold for n data points."""
-    idx = np.arange(n)
-    for fold in range(k):
-        test = idx[idx % k == fold]
-        train = idx[idx % k != fold]
-        yield train, test
+# the e2 CrossValidation.splitData analog lives in core.cross_validation
+# (shared by every engine's readEval); re-exported here because the e2
+# module also shipped it next to the vectorizer
+from predictionio_tpu.core.cross_validation import split_data  # noqa: E402,F401

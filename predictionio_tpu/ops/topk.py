@@ -1,0 +1,30 @@
+"""Host-side top-k.
+
+The host counterpart of `jax.lax.top_k` for the dispatch-latency-aware
+paths (serving in models/als.py, single-device-CPU cooccurrence): when a
+model is small enough that one device round-trip costs more than the
+whole scoring matmul, the top-k runs on host BLAS output instead.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def host_topk(scores: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row descending top-k: [B, N] -> (values [B, k], idx [B, k]).
+
+    k is clamped to N. argpartition + argsort of the k-prefix, the
+    O(N + k log k) idiom numpy lacks a primitive for.
+    """
+    n = scores.shape[1]
+    k = min(k, n)
+    if k >= n:
+        idx = np.argsort(-scores, axis=1)
+    else:
+        part = np.argpartition(-scores, k, axis=1)[:, :k]
+        order = np.argsort(-np.take_along_axis(scores, part, axis=1), axis=1)
+        idx = np.take_along_axis(part, order, axis=1)
+    return np.take_along_axis(scores, idx, axis=1), idx

@@ -144,19 +144,22 @@ class Checkpointer:
         """(step, state) of the newest readable, compatible snapshot.
 
         Scans steps newest-first. Unreadable, malformed, or forbidden
-        snapshots are skipped with a warning; so are snapshots whose
-        fingerprint differs from (or lacks) the given one — a restarted
-        run whose params or data changed retrains from scratch rather
-        than resuming from incompatible state. Reads never delete: stale
-        lineages are left for their own run (or `clear`) — per-lineage
-        `_gc` means they cannot starve this run's snapshots either."""
+        snapshots are skipped with a warning; so are snapshots of a
+        DIFFERENT lineage: with a fingerprint given, only snapshots
+        carrying that exact fingerprint match; with fingerprint=None only
+        untagged snapshots match — a fingerprint-less caller never
+        resumes from some other run's tagged state (and vice versa).
+        A restarted run whose params or data changed retrains from
+        scratch rather than resuming from incompatible state. Reads
+        never delete: stale lineages are left for their own run (or
+        `clear`) — per-lineage `_gc` means they cannot starve this run's
+        snapshots either."""
         entries = sorted(self._scan(), reverse=True,
                          key=lambda e: (e[0], e[1] or "", e[2]))
         want_tag = _tag(fingerprint)
         for step, tag, name in entries:
             path = os.path.join(self.directory, name)
-            if fingerprint is not None and tag is not None \
-                    and tag != want_tag:
+            if tag != want_tag:
                 continue          # other lineage, by filename alone
             try:
                 with open(path, "rb") as f:
@@ -179,8 +182,7 @@ class Checkpointer:
                 logger.warning("checkpoint %s unreadable (%s) — skipping",
                                path, e)
                 continue
-            if fingerprint is not None \
-                    and snap.get("fingerprint") != fingerprint:
+            if snap.get("fingerprint") != fingerprint:
                 logger.warning(
                     "checkpoint %s fingerprint mismatch (snapshot %s, "
                     "run %s) — ignoring, training from scratch",

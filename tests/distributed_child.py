@@ -1,0 +1,87 @@
+"""Child process for the multi-process runtime test (not a test module).
+
+Each of N processes runs this same program — the single-controller SPMD
+contract of parallel/distributed.py (SURVEY §2.9 P5, the role Spark's
+driver/executor split plays via Runner.scala:185). It initializes the
+distributed runtime, assembles mesh-sharded training data from its LOCAL
+shard only (P2), runs a sharded ALS train over devices spanning both
+processes (P3/P4 collectives over the Gloo-backed CPU runtime), and
+prints the resulting factors as JSON for the parent to compare against a
+single-process reference run.
+
+Usage: python distributed_child.py <process_id> <num_processes> <port>
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+    from predictionio_tpu.utils.config import honor_jax_platforms
+
+    honor_jax_platforms()
+
+    from predictionio_tpu.parallel.distributed import (
+        initialize_distributed, process_count, process_index)
+
+    initialize_distributed(coordinator_address=f"localhost:{port}",
+                           num_processes=nproc, process_id=pid)
+    assert process_count() == nproc
+    assert process_index() == pid
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+
+    devices = np.asarray(jax.devices())      # spans both processes
+    assert devices.size == nproc, devices
+    mesh = Mesh(devices, axis_names=("data",))
+
+    # identical deterministic ratings everywhere; .put() slices out the
+    # local shard so only this process's rows reach its device
+    rng = np.random.default_rng(7)
+    n_users, n_items = 48, 32
+    mask = rng.random((n_users, n_items)) < 0.4
+    users, items = np.nonzero(mask)
+    u_lat = rng.normal(size=(n_users, 3)).astype(np.float32)
+    v_lat = rng.normal(size=(n_items, 3)).astype(np.float32)
+    ratings = (u_lat @ v_lat.T)[users, items].astype(np.float32)
+
+    data = ALSData.build(users.astype(np.int32), items.astype(np.int32),
+                         ratings, n_users, n_items, n_shards=nproc).put(mesh)
+    params = ALSParams(rank=4, num_iterations=3, chunk_size=64)
+    U, V = train_als(mesh, data, params)
+
+    # checkpointed multihost training: per-host (NON-shared) snapshot
+    # dirs, so only process 0 writes and the resume decision rides the
+    # broadcast — must reproduce the plain run exactly
+    import tempfile
+
+    from predictionio_tpu.workflow.checkpoint import Checkpointer
+
+    with tempfile.TemporaryDirectory() as ckdir:
+        ck = Checkpointer(ckdir, interval=2)
+        U2, V2 = train_als(mesh, data, params, checkpointer=ck)
+        wrote = any(f.endswith(".pkl") for f in os.listdir(ckdir))
+    assert np.allclose(U, U2, atol=1e-5), "checkpointed run diverged"
+    assert wrote == (pid == 0), (
+        f"process {pid} snapshot writes: expected {pid == 0}, got {wrote}")
+
+    print("RESULT " + json.dumps({
+        "pid": pid,
+        "U_sum": float(np.abs(U).sum()),
+        "V_sum": float(np.abs(V).sum()),
+        "U_row0": np.asarray(U[0]).tolist(),
+        "V_row0": np.asarray(V[0]).tolist(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -112,3 +112,39 @@ def test_sessionrec_eval_folds(session_app):
     # held-out session tails never appear in that fold's training data
     q0, a0 = qa[0]
     assert a0.item  # leave-one-out target present
+
+
+def test_sessionrec_resume_rejects_mismatched_opt_state(tmp_path, caplog):
+    """Round-3 advisor regression: a snapshot whose optimizer leaves have
+    the right COUNT but wrong shape/dtype must resume params with RESET
+    adam moments (warning), never feed mis-shaped moments to the first
+    apply_updates."""
+    import logging
+    import pickle
+
+    from predictionio_tpu.engines.sessionrec import AlgorithmParams
+    from predictionio_tpu.models.seqrec import train_seqrec
+    from predictionio_tpu.workflow.checkpoint import Checkpointer
+
+    sessions = [[f"i{(s + j) % 6}" for j in range(4)] for s in range(12)]
+    p = AlgorithmParams(d_model=8, n_heads=2, n_layers=1, max_len=8,
+                        epochs=2, batch_size=4)
+    ck = Checkpointer(str(tmp_path), interval=1)
+    train_seqrec(None, sessions, p, checkpointer=ck)
+
+    # tamper every snapshot: truncate each opt leaf to shape () f16 —
+    # leaf count stays right, shapes/dtypes go wrong
+    snaps = [f for f in tmp_path.iterdir() if f.suffix == ".pkl"]
+    assert snaps, "interval=1 must have left a mid-train snapshot"
+    for f in snaps:
+        snap = pickle.loads(f.read_bytes())
+        snap["state"]["opt_leaves"] = [
+            np.float16(0) for _ in snap["state"]["opt_leaves"]]
+        f.write_bytes(pickle.dumps(snap))
+
+    p5 = AlgorithmParams(d_model=8, n_heads=2, n_layers=1, max_len=8,
+                        epochs=3, batch_size=4)
+    with caplog.at_level(logging.WARNING):
+        model = train_seqrec(None, sessions, p5, checkpointer=ck)
+    assert model.recommend_next(["i0", "i1"], 2)
+    assert any("RESET adam moments" in r.message for r in caplog.records)

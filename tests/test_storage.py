@@ -7,6 +7,7 @@ remove"), plus metadata store CRUD.
 """
 
 import datetime as dt
+import os
 
 import pytest
 
@@ -27,7 +28,32 @@ def t(days):
     return T0 + dt.timedelta(days=days)
 
 
-@pytest.fixture(params=["sqlite", "parquet", "evlog-native", "evlog-python"])
+def _postgres_store_or_skip():
+    """A PostgresEvents wired to PIO_TEST_POSTGRES_URL, or skip.
+
+    The live-server leg of the reference's backend contract CI
+    (storage/jdbc/src/test/.../LEventsSpec.scala:26-63 runs against a
+    dockerized postgres). This image ships neither server nor driver, so
+    the leg skips cleanly here and activates wherever
+    PIO_TEST_POSTGRES_URL points at a real database."""
+    url = os.environ.get("PIO_TEST_POSTGRES_URL")
+    if not url:
+        pytest.skip("PIO_TEST_POSTGRES_URL not set (no postgres server)")
+    from predictionio_tpu.storage.postgres_backend import (
+        PostgresClient, PostgresEvents)
+
+    try:
+        client = PostgresClient(url)
+        s = PostgresEvents(client)
+        # fresh contract namespace every run
+        s.remove_channel(1)
+    except StorageError as e:
+        pytest.skip(f"postgres unavailable: {e}")
+    return s
+
+
+@pytest.fixture(params=["sqlite", "parquet", "evlog-native", "evlog-python",
+                        "postgres"])
 def store(tmp_path, request):
     """One shared behavioral contract, run against every event backend
     (the reference's LEventsSpec/PEventsSpec pattern)."""
@@ -35,6 +61,8 @@ def store(tmp_path, request):
         s = SqliteEvents(SqliteClient(str(tmp_path / "events.db")))
     elif request.param == "parquet":
         s = ParquetEvents(ParquetEventsClient(str(tmp_path / "events_pq")))
+    elif request.param == "postgres":
+        s = _postgres_store_or_skip()
     else:
         from predictionio_tpu.storage.evlog_backend import (
             EvlogClient, EvlogEvents)
@@ -180,10 +208,19 @@ def test_find_columnar(store):
 
 # -- metadata stores ---------------------------------------------------------
 
-@pytest.fixture()
-def meta(tmp_path):
+@pytest.fixture(params=["sqlite", "postgres"])
+def meta(tmp_path, request):
+    """Metadata-store contract, sqlite always + postgres when a live
+    server is reachable (the JDBC metadata CI leg)."""
+    if request.param == "postgres":
+        url = os.environ.get("PIO_TEST_POSTGRES_URL")
+        if not url:
+            pytest.skip("PIO_TEST_POSTGRES_URL not set (no postgres server)")
+        db = {"TYPE": "postgres", "URL": url}
+    else:
+        db = {"TYPE": "sqlite", "PATH": str(tmp_path / "meta.db")}
     Storage.configure({
-        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "meta.db")},
+        "sources": {"DB": db,
                     "FS": {"TYPE": "localfs", "PATH": str(tmp_path / "models")}},
         "repositories": {
             "METADATA": {"NAME": "pio", "SOURCE": "DB"},
@@ -191,6 +228,11 @@ def meta(tmp_path):
             "MODELDATA": {"NAME": "pio", "SOURCE": "FS"},
         },
     })
+    try:
+        Storage.verify_all_data_objects()
+    except StorageError as e:
+        Storage.reset()
+        pytest.skip(f"backend unavailable: {e}")
     yield Storage
     Storage.reset()
 

@@ -409,7 +409,7 @@ def cfg_pipeline_ml100k(jax, mesh, platform):
         engine = engine_factory()
         ep = default_engine_params("BenchApp", rank=RANK,
                                    num_iterations=ITERS)
-        hb("pipeline train (cold: read+build+compile+train)")
+        hb("pipeline train (cold: read+build+jit+train)")
         t0 = time.perf_counter()
         instance = run_train(
             engine, ep,
@@ -674,6 +674,14 @@ def cfg_eval_sweep(jax, mesh, platform):
             "note": f"best rank {best_rank}, test-RMSE {best_err:.3f}"}
 
 
+def cfg_sleep_forever(jax, mesh, platform):
+    """Test-only config (never in the default set): wedges the worker so
+    the orchestrator's watchdog + ladder can be exercised on CPU."""
+    hb("sleep_forever compile+warmup")     # trips the Pallas-bisect path
+    while True:
+        time.sleep(1)
+
+
 #: name -> (fn, seconds budget measured from RUN dispatch to BENCH_DETAIL)
 CONFIGS = {
     "als_ml100k": (cfg_als_ml100k, 240),
@@ -684,6 +692,9 @@ CONFIGS = {
     "eval_sweep_3fold_3rank": (cfg_eval_sweep, 420),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
+
+#: wedge-simulator, reachable only via --only (watchdog/ladder testing)
+CONFIGS["_sleep_forever"] = (cfg_sleep_forever, 15)
 
 INIT_BUDGET_S = 420      # TPU claim through the relay; measured in minutes
 
@@ -742,13 +753,16 @@ class WorkerHandle:
     queue; stderr lines are echoed to our stderr and kept (tail) for
     failure forensics."""
 
-    def __init__(self, args):
+    def __init__(self, args, extra_env=None):
         import queue
 
+        env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
         self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)] + args,
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True, bufsize=1)
+            stderr=subprocess.PIPE, text=True, bufsize=1, env=env)
         self.lines: "queue.Queue[str]" = queue.Queue()
         self.err_tail = []
         threading.Thread(target=self._pump_out, daemon=True).start()
@@ -825,8 +839,9 @@ class Suite:
 
     # -- workers ------------------------------------------------------------
 
-    def start_worker(self, platform):
-        w = WorkerHandle(["--worker", "--platform", platform])
+    def start_worker(self, platform, extra_env=None):
+        w = WorkerHandle(["--worker", "--platform", platform],
+                         extra_env=extra_env)
         line = w.read_until(
             ("DEVINFO",),
             min(self.deadline - 30, time.monotonic() + INIT_BUDGET_S))
@@ -852,6 +867,12 @@ class Suite:
             self.done.add(name)
             return True
         if not w.send(name):
+            # worker died between configs: leave a trail (superseded if a
+            # retry on a fresh worker succeeds)
+            self.failures.append({"name": name,
+                                  "error": "worker dead (stdin closed)",
+                                  "last_heartbeats": w.err_tail[-5:]})
+            log(f"{name}: worker dead before dispatch")
             return False
         line = w.read_until(("BENCH_DETAIL", "CONFIG_FAILED"), deadline)
         if line is None:
@@ -878,8 +899,12 @@ class Suite:
         # not report a config as both failed and measured
         self.failures = [f for f in self.failures if f.get("name") != name]
         base = self.baselines.get(name, {})
-        # never clobber a baseline the worker measured itself (the scaled
-        # CPU ml20m run carries its own matched baseline)
+        # never clobber — or MIX METADATA INTO — a baseline the worker
+        # measured itself (the scaled CPU ml20m run carries its own
+        # matched baseline; the external entry describes a different
+        # workload shape)
+        if "baseline_s" in detail:
+            base = {}
         detail.update({k: v for k, v in base.items()
                        if k != "name" and k not in detail})
         b, e = detail.get("baseline_s"), detail.get("elapsed_s")
@@ -987,16 +1012,38 @@ def orchestrate(names):
     base_proc.kill()
     log(f"baselines measured: {sorted(suite.baselines)}")
 
+    solve_env = {}
+
     def replace_wedged_worker(old):
         """Kill a wedged worker and ladder down: one accelerator respawn,
-        then CPU. Returns the replacement (None = nothing startable)."""
+        then CPU. Returns the replacement (None = nothing startable).
+
+        A wedge whose last heartbeat was a compile phase triggers the
+        Pallas bisect: the respawned accelerator worker (and everything
+        after) runs with PIO_TPU_SOLVE=vec, swapping the Pallas Cholesky
+        for the vectorized JAX path — if the retry then passes, the
+        artifact itself localizes the hang to the Pallas kernel."""
         nonlocal platform, attempts
         old.kill()
         if platform != "cpu":
-            if attempts < 1:
+            # only the dedicated compile-phase marker triggers the bisect
+            # (a wedge in some other phase that merely MENTIONS compiling
+            # must not silently swap the judged solve kernel)
+            tail = " ".join(old.err_tail[-3:])
+            bisect = "compile+warmup" in tail \
+                and "PIO_TPU_SOLVE" not in solve_env
+            if bisect:
+                solve_env["PIO_TPU_SOLVE"] = "vec"
+                log("wedge during compile phase — retrying with "
+                    "PIO_TPU_SOLVE=vec (Pallas bisect)")
+                suite.failures.append(
+                    {"name": "_pallas_bisect",
+                     "error": "compile-phase wedge; switched to "
+                              "PIO_TPU_SOLVE=vec for remaining configs"})
+            if attempts < 1 or bisect:   # the bisect earns its own respawn
                 attempts += 1
                 log("respawning worker after wedge")
-                nxt = suite.start_worker(platform)
+                nxt = suite.start_worker(platform, extra_env=solve_env)
                 if nxt is not None:
                     return nxt
             platform = "cpu"
@@ -1056,7 +1103,7 @@ def main():
         print("BENCH_DETAIL " + json.dumps(detail), flush=True)
         os._exit(0)
 
-    names = list(CONFIGS)
+    names = [n for n in CONFIGS if not n.startswith("_")]
     if args.only:
         names = args.only.split(",")
         unknown = [n for n in names if n not in CONFIGS]

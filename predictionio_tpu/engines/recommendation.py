@@ -44,13 +44,49 @@ class Rating:
 
 
 @dataclasses.dataclass
+class RatingColumns:
+    """Columnar view of the rating set — the RDD[Rating] analog the way a
+    TPU pipeline wants it: three parallel arrays straight from the event
+    store's columnar scan, no per-event Python objects."""
+
+    users: np.ndarray    # object (string ids)
+    items: np.ndarray    # object
+    values: np.ndarray   # float32
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+
+@dataclasses.dataclass
 class TrainingData:
-    ratings: List[Rating]
+    """Holds the rating set as rows (`ratings`, reference-API parity) or
+    columns (`columns`, the training fast path) — whichever the reader
+    produced; `as_columns()` converts on demand."""
+
+    ratings: Optional[List[Rating]] = None
+    columns: Optional[RatingColumns] = None
+
+    def as_columns(self) -> RatingColumns:
+        if self.columns is not None:
+            return self.columns
+        rs = self.ratings or []
+        return RatingColumns(
+            users=np.asarray([r.user for r in rs], dtype=object),
+            items=np.asarray([r.item for r in rs], dtype=object),
+            values=np.asarray([r.rating for r in rs], dtype=np.float32))
+
+    def __len__(self) -> int:
+        return (len(self.columns) if self.columns is not None
+                else len(self.ratings or ()))
 
 
 @dataclasses.dataclass
 class PreparedData:
-    ratings: List[Rating]
+    ratings: Optional[List[Rating]] = None
+    columns: Optional[RatingColumns] = None
+
+    as_columns = TrainingData.as_columns
+    __len__ = TrainingData.__len__
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,26 +154,44 @@ class RecommendationDataSource(DataSource):
         self.params = params
 
     def _read_ratings(self) -> List[Rating]:
+        c = self._read_columns()
+        return [Rating(user=u, item=i, rating=float(v))
+                for u, i, v in zip(c.users, c.items, c.values)]
+
+    def _read_columns(self) -> RatingColumns:
+        """Columnar training read (find_columnar -> arrays), the
+        JDBCPEvents-into-RDD analog without per-event objects."""
+        import json as _json
+
         names = self.params.event_names or ["rate", "buy"]
         weights = {**self.DEFAULT_WEIGHTS, **(self.params.event_weights or {})}
-        events = EventStoreClient.find(
+        table = EventStoreClient.find_columnar(
             app_name=self.params.app_name,
             entity_type="user",
             event_names=names,
             target_entity_type="item")
-        ratings = []
-        for e in events:
-            if e.event == "rate":
-                rating = float(e.properties.get("rating"))
-            else:
-                rating = float(weights.get(e.event, 1.0))
-            ratings.append(Rating(user=e.entity_id,
-                                  item=e.target_entity_id,
-                                  rating=rating))
-        return ratings
+        events = np.asarray(table.column("event").to_pylist(), dtype=object)
+        users = np.asarray(table.column("entity_id").to_pylist(),
+                           dtype=object)
+        items = np.asarray(table.column("target_entity_id").to_pylist(),
+                           dtype=object)
+        props = table.column("properties").to_pylist()
+        values = np.empty(len(events), np.float32)
+        for name in set(events.tolist()):
+            if name != "rate":
+                values[events == name] = float(weights.get(name, 1.0))
+        for j in np.nonzero(events == "rate")[0]:
+            p = props[j]
+            r = _json.loads(p).get("rating") if p else None
+            values[j] = float(r) if r is not None else np.nan
+        if np.isnan(values).any():
+            raise ValueError(
+                "rate event without a rating property "
+                "(DataSource.scala:66 MatchError parity)")
+        return RatingColumns(users=users, items=items, values=values)
 
     def read_training(self, ctx) -> TrainingData:
-        return TrainingData(ratings=self._read_ratings())
+        return TrainingData(columns=self._read_columns())
 
     def read_eval(self, ctx):
         """K-fold split via the shared helper (DataSource.scala:87-120 /
@@ -160,7 +214,7 @@ class RecommendationPreparator(Preparator):
     """Template passthrough preparator (Preparator.scala parity)."""
 
     def prepare(self, ctx, td: TrainingData) -> PreparedData:
-        return PreparedData(ratings=td.ratings)
+        return PreparedData(ratings=td.ratings, columns=td.columns)
 
 
 @dataclasses.dataclass
@@ -186,13 +240,12 @@ class ALSAlgorithm(Algorithm):
         self.params = params or AlgorithmParams()
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
-        if not pd.ratings:
+        if not len(pd):
             raise ValueError(
                 "No ratings found. Check the appName or import data first "
                 "(ALSAlgorithm.scala:55 empty-check parity).")
-        users = np.asarray([r.user for r in pd.ratings], dtype=object)
-        items = np.asarray([r.item for r in pd.ratings], dtype=object)
-        values = np.asarray([r.rating for r in pd.ratings], dtype=np.float32)
+        cols = pd.as_columns()
+        users, items, values = cols.users, cols.items, cols.values
         user_vocab, user_codes = assign_indices(users)
         item_vocab, item_codes = assign_indices(items)
         from predictionio_tpu.workflow.context import mesh_of

@@ -206,10 +206,24 @@ class ALSData:
         analog)."""
         multiproc = jax.process_count() > 1
         if multiproc:
+            # the local-slice math below requires the standard layouts:
+            # one shard row per mesh position, and each process's devices
+            # occupying a CONTIGUOUS run of mesh.devices.flat (the order
+            # jax.devices() yields on multi-host). Anything else would
+            # silently mis-assemble training data — fail loudly instead.
+            n_rows = self.by_user.tgt.shape[0]
+            assert n_rows == mesh.devices.size, (
+                f"data built for {n_rows} shards but mesh has "
+                f"{mesh.devices.size} devices — build with "
+                "n_shards=mesh.devices.size for multi-process put()")
             me = jax.process_index()
             rows_mine = [i for i, d in enumerate(mesh.devices.flat)
                          if d.process_index == me]
             lo, hi = min(rows_mine), max(rows_mine) + 1
+            assert len(rows_mine) == hi - lo, (
+                "mesh interleaves processes along the shard axis "
+                f"(process {me} owns rows {rows_mine}); multi-process "
+                "put() requires process-contiguous device order")
 
         def commit_one(arr, sharding):
             if not multiproc:

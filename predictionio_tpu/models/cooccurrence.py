@@ -92,58 +92,44 @@ def cooccurrence_topn(mesh, user_idx: np.ndarray, item_idx: np.ndarray,
     return np.asarray(vals)[:n_items], np.asarray(idx)[:n_items]
 
 
-#: compiled sharded count+topk fns, keyed on everything that shapes the
-#: program — rebuilding the jit wrapper per call would re-trace and
-#: re-compile every time (eval sweeps train cooccurrence once per fold)
-_TOPN_FN_CACHE: "OrderedDict" = None
-_TOPN_FN_CACHE_MAX = 8
-
-
 def _sharded_topn_fn(mesh, axis: str, n_dev: int, blk: int, ni_pad: int,
                      k: int):
-    global _TOPN_FN_CACHE
-    from collections import OrderedDict
+    """Compiled sharded count+topk fn, cached per (mesh, shape params) —
+    a per-call jit wrapper would re-trace every fold of an eval sweep."""
+    from predictionio_tpu.ops.fn_cache import mesh_cached_fn
 
-    if _TOPN_FN_CACHE is None:
-        _TOPN_FN_CACHE = OrderedDict()
-    key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
-           axis, blk, ni_pad, k)
-    fn = _TOPN_FN_CACHE.get(key)
-    if fn is not None:
-        _TOPN_FN_CACHE.move_to_end(key)
-        return fn
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
 
-    import jax
-    import jax.numpy as jnp
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
+        def block(a_cols, a_full):
+            # a_cols [nu, blk]: this device's item block; a_full replicated
+            c = jnp.dot(a_cols.T, a_full,
+                        preferred_element_type=jnp.float32)  # [blk, ni_pad]
+            row0 = jax.lax.axis_index(axis) * blk
+            rows = row0 + jnp.arange(blk)[:, None]
+            cols = jnp.arange(ni_pad)[None, :]
+            c = jnp.where(rows == cols, 0.0, c)              # zero diagonal
+            vals, idx = jax.lax.top_k(c, k)
+            return vals[None], idx[None]
 
-    def block(a_cols, a_full):
-        # a_cols [nu, blk] — this device's item block; a_full replicated
-        c = jnp.dot(a_cols.T, a_full,
-                    preferred_element_type=jnp.float32)   # [blk, ni_pad]
-        row0 = jax.lax.axis_index(axis) * blk
-        rows = row0 + jnp.arange(blk)[:, None]
-        cols = jnp.arange(ni_pad)[None, :]
-        c = jnp.where(rows == cols, 0.0, c)               # zero diagonal
-        vals, idx = jax.lax.top_k(c, k)
-        return vals[None], idx[None]
+        sharded = shard_map(
+            block, mesh=mesh,
+            in_specs=(P(None, axis), P()),
+            out_specs=(P(axis, None, None), P(axis, None, None)),
+            check_vma=False)
 
-    sharded = shard_map(
-        block, mesh=mesh,
-        in_specs=(P(None, axis), P()),
-        out_specs=(P(axis, None, None), P(axis, None, None)),
-        check_vma=False)
+        @jax.jit
+        def run(a_dev):
+            vals, idx = sharded(a_dev, a_dev)
+            return (vals.reshape(ni_pad, k), idx.reshape(ni_pad, k))
 
-    @jax.jit
-    def run(a_dev):
-        vals, idx = sharded(a_dev, a_dev)
-        return (vals.reshape(ni_pad, k), idx.reshape(ni_pad, k))
+        return run
 
-    _TOPN_FN_CACHE[key] = run
-    while len(_TOPN_FN_CACHE) > _TOPN_FN_CACHE_MAX:
-        _TOPN_FN_CACHE.popitem(last=False)
-    return run
+    return mesh_cached_fn("cooccurrence_topn", mesh,
+                          (axis, blk, ni_pad, k), build)
 
 
 def cooccurrence_topn_host(user_idx: np.ndarray, item_idx: np.ndarray,
@@ -182,9 +168,14 @@ def train_cooccurrence(user_idx: np.ndarray, item_idx: np.ndarray,
     if len(user_idx) == 0:
         return {}
     user_idx, item_idx = distinct_pairs(user_idx, item_idx)
-    # both the [n_users, n_items] interaction matrix AND the
-    # [n_items, n_items] count matrix must fit the budget
-    if max(n_users * n_items, n_items * n_items) <= DENSE_BUDGET:
+    # budget check BEFORE any jax backend init (jax.devices() claims the
+    # chip — pointless and potentially minutes-slow over a tunnel when
+    # the host fallback is going to run anyway). The padded width is what
+    # actually gets allocated/replicated: [n_users, ni_pad] at 128-lane x
+    # device-count blocks, plus the [n_items, n_items] count matrix.
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    ni_pad = -(-n_items // (128 * n_dev)) * 128 * n_dev
+    if max(n_users * ni_pad, n_items * n_items) <= DENSE_BUDGET:
         if mesh is None:
             import jax
             from jax.sharding import Mesh

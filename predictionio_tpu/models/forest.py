@@ -161,22 +161,19 @@ def _fit_forest(bins, labels, boot_idx, feat_mask, n_classes, max_depth,
     )(boot_idx, feat_mask)
 
 
-#: compiled tree-sharded fit fns keyed on mesh + static hyperparams — a
-#: per-call jit(shard_map(...)) wrapper would re-trace every fold of a
-#: cross-validated eval (jit's cache keys on function identity)
-_SHARDED_FIT_CACHE: dict = {}
-
-
 def _sharded_fit_fn(mesh, c: int, depth: int, b: int, impurity: str):
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
+    """Compiled tree-sharded fit fn, cached per (mesh, hyperparams) — a
+    per-call jit(shard_map(...)) wrapper would re-trace every fold of a
+    cross-validated eval (jit's cache keys on function identity)."""
+    from predictionio_tpu.ops.fn_cache import mesh_cached_fn
 
     axis = mesh.axis_names[0]
-    key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
-           axis, c, depth, b, impurity)
-    fn = _SHARDED_FIT_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(shard_map(
+
+    def build():
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        return jax.jit(shard_map(
             lambda xqd, cd, bi, fm: jax.vmap(
                 lambda one_b, one_m: _fit_kernel(
                     xqd, cd, one_b, one_m, c, depth, b, impurity)
@@ -185,10 +182,9 @@ def _sharded_fit_fn(mesh, c: int, depth: int, b: int, impurity: str):
             in_specs=(P(), P(), P(axis, None), P(axis, None, None)),
             out_specs=(P(axis, None), P(axis, None), P(axis, None)),
             check_vma=False))
-        _SHARDED_FIT_CACHE[key] = fn
-        while len(_SHARDED_FIT_CACHE) > 8:
-            _SHARDED_FIT_CACHE.pop(next(iter(_SHARDED_FIT_CACHE)))
-    return fn
+
+    return mesh_cached_fn("forest_fit", mesh, (axis, c, depth, b, impurity),
+                          build)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_classes"))

@@ -105,33 +105,27 @@ def train_categorical_nb(points: Sequence[LabeledPoint]
 #: (or sharded-device) count matmul can't repay its transfer + dispatch
 DEVICE_MIN_SIZE = 1_000_000
 
-#: compiled sharded count fns keyed on mesh + label count (jit's cache
-#: keys on function identity, so the wrapper must be reused across calls)
-_SHARDED_COUNT_CACHE: dict = {}
-
-
 def _sharded_count_fn(mesh, axis: str, n_labels: int):
-    import jax
-    import jax.numpy as jnp
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
+    """Compiled sharded count fn, cached per (mesh, n_labels) — jit's
+    cache keys on function identity, so the wrapper must be reused."""
+    from predictionio_tpu.ops.fn_cache import mesh_cached_fn
 
-    key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
-           axis, n_labels)
-    fn = _SHARDED_COUNT_CACHE.get(key)
-    if fn is None:
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
         def count_block(c, x):
             onehot = jax.nn.one_hot(c, n_labels, dtype=jnp.float32)
             return jax.lax.psum(onehot.T @ x.astype(jnp.float32), axis)
 
-        fn = jax.jit(shard_map(
+        return jax.jit(shard_map(
             count_block, mesh=mesh,
             in_specs=(P(axis), P(axis, None)),
             out_specs=P()))
-        _SHARDED_COUNT_CACHE[key] = fn
-        while len(_SHARDED_COUNT_CACHE) > 8:
-            _SHARDED_COUNT_CACHE.pop(next(iter(_SHARDED_COUNT_CACHE)))
-    return fn
+
+    return mesh_cached_fn("nb_count", mesh, (axis, n_labels), build)
 
 
 def _compact_for_transfer(X: np.ndarray) -> np.ndarray:

@@ -1,0 +1,36 @@
+"""Shared cache for mesh-closed compiled functions.
+
+jit's own cache keys on function identity, so any wrapper built per call
+(`jax.jit(shard_map(closure, ...))`) re-traces every time. Model modules
+register their builders here instead: one bounded LRU per family, keyed
+on the (hashable) Mesh plus whatever static parameters shape the program.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, Hashable
+
+_CACHES: Dict[str, "OrderedDict" ] = {}
+
+MAX_PER_FAMILY = 8
+
+
+def mesh_cached_fn(family: str, mesh, static_key: Hashable,
+                   build: Callable[[], Callable]) -> Callable:
+    """The compiled fn for (family, mesh, static_key), building it on
+    first use. `mesh` participates in the key directly (jax.sharding.Mesh
+    is hashable by devices+axis names — no id() aliasing). Bounded LRU
+    per family so long-lived servers retraining on growing data don't
+    accumulate executables forever."""
+    cache = _CACHES.setdefault(family, OrderedDict())
+    key = (mesh, static_key)
+    fn = cache.get(key)
+    if fn is None:
+        fn = build()
+        cache[key] = fn
+        while len(cache) > MAX_PER_FAMILY:
+            cache.popitem(last=False)
+    else:
+        cache.move_to_end(key)
+    return fn

@@ -17,6 +17,24 @@ import os
 import sys
 
 
+def make_toy_ratings():
+    """The shared deterministic rating set: (users, items, ratings,
+    n_users, n_items). The parent test trains the SAME data single-
+    process and asserts the factors agree — one definition, imported by
+    both sides, so the datasets cannot drift apart."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    n_users, n_items = 48, 32
+    mask = rng.random((n_users, n_items)) < 0.4
+    users, items = np.nonzero(mask)
+    u_lat = rng.normal(size=(n_users, 3)).astype(np.float32)
+    v_lat = rng.normal(size=(n_items, 3)).astype(np.float32)
+    ratings = (u_lat @ v_lat.T)[users, items].astype(np.float32)
+    return (users.astype(np.int32), items.astype(np.int32), ratings,
+            n_users, n_items)
+
+
 def main() -> None:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -46,16 +64,10 @@ def main() -> None:
 
     # identical deterministic ratings everywhere; .put() slices out the
     # local shard so only this process's rows reach its device
-    rng = np.random.default_rng(7)
-    n_users, n_items = 48, 32
-    mask = rng.random((n_users, n_items)) < 0.4
-    users, items = np.nonzero(mask)
-    u_lat = rng.normal(size=(n_users, 3)).astype(np.float32)
-    v_lat = rng.normal(size=(n_items, 3)).astype(np.float32)
-    ratings = (u_lat @ v_lat.T)[users, items].astype(np.float32)
+    users, items, ratings, n_users, n_items = make_toy_ratings()
 
-    data = ALSData.build(users.astype(np.int32), items.astype(np.int32),
-                         ratings, n_users, n_items, n_shards=nproc).put(mesh)
+    data = ALSData.build(users, items, ratings, n_users, n_items,
+                         n_shards=nproc).put(mesh)
     params = ALSParams(rank=4, num_iterations=3, chunk_size=64)
     U, V = train_als(mesh, data, params)
 

@@ -67,19 +67,14 @@ def test_two_process_sharded_als_matches_single_process():
     # ...and match a single-process train of the same data (the shard
     # layout is a performance choice, not a semantic one)
     from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+    from tests.distributed_child import make_toy_ratings
     import jax
     from jax.sharding import Mesh
 
-    rng = np.random.default_rng(7)
-    n_users, n_items = 48, 32
-    mask = rng.random((n_users, n_items)) < 0.4
-    users, items = np.nonzero(mask)
-    u_lat = rng.normal(size=(n_users, 3)).astype(np.float32)
-    v_lat = rng.normal(size=(n_items, 3)).astype(np.float32)
-    ratings = (u_lat @ v_lat.T)[users, items].astype(np.float32)
+    users, items, ratings, n_users, n_items = make_toy_ratings()
     mesh = Mesh(np.asarray(jax.devices()[:2]), axis_names=("data",))
-    data = ALSData.build(users.astype(np.int32), items.astype(np.int32),
-                         ratings, n_users, n_items, n_shards=2)
+    data = ALSData.build(users, items, ratings, n_users, n_items,
+                         n_shards=2)
     params = ALSParams(rank=4, num_iterations=3, chunk_size=64)
     U, V = train_als(mesh, data, params)
     np.testing.assert_allclose(np.asarray(U[0]), results[0]["U_row0"],

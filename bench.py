@@ -331,8 +331,10 @@ def cfg_als_ml100k(jax, mesh, platform):
 
     nu, ni, nnz = 943, 1682, 100_000
     users, items, ratings = synthetic_ratings(nu, ni, nnz)
-    params = ALSParams(rank=RANK, num_iterations=ITERS, reg=REG,
-                       chunk_size=16384)
+    # default chunk_size = engine parity: pipeline_ml100k's run_train
+    # then reuses THIS config's compiled program (same worker, same jit
+    # cache), so its cold train measures work, not XLA compile
+    params = ALSParams(rank=RANK, num_iterations=ITERS, reg=REG)
     hb("als_ml100k data-build")
     data, build_s, transfer_s = _als_device_data(
         jax, mesh, users, items, ratings, nu, ni)
@@ -1026,11 +1028,12 @@ def orchestrate(names):
         nonlocal platform, attempts
         old.kill()
         if platform != "cpu":
-            # only the dedicated compile-phase marker triggers the bisect
-            # (a wedge in some other phase that merely MENTIONS compiling
-            # must not silently swap the judged solve kernel)
-            tail = " ".join(old.err_tail[-3:])
-            bisect = "compile+warmup" in tail \
+            # only the dedicated compile-phase marker — and only as the
+            # LAST heartbeat — triggers the bisect (a wedge in a later
+            # phase whose scrollback still shows the compile line must
+            # not silently swap the judged solve kernel)
+            last_hb = old.err_tail[-1] if old.err_tail else ""
+            bisect = "compile+warmup" in last_hb \
                 and "PIO_TPU_SOLVE" not in solve_env
             if bisect:
                 solve_env["PIO_TPU_SOLVE"] = "vec"

@@ -161,7 +161,7 @@ class RecommendationDataSource(DataSource):
     def _read_columns(self) -> RatingColumns:
         """Columnar training read (find_columnar -> arrays), the
         JDBCPEvents-into-RDD analog without per-event objects."""
-        import json as _json
+        from predictionio_tpu.data.columnar import property_column
 
         names = self.params.event_names or ["rate", "buy"]
         weights = {**self.DEFAULT_WEIGHTS, **(self.params.event_weights or {})}
@@ -175,16 +175,15 @@ class RecommendationDataSource(DataSource):
                            dtype=object)
         items = np.asarray(table.column("target_entity_id").to_pylist(),
                            dtype=object)
-        props = table.column("properties").to_pylist()
+        is_rate = events == "rate"
         values = np.empty(len(events), np.float32)
         for name in set(events.tolist()):
             if name != "rate":
                 values[events == name] = float(weights.get(name, 1.0))
-        for j in np.nonzero(events == "rate")[0]:
-            p = props[j]
-            r = _json.loads(p).get("rating") if p else None
-            values[j] = float(r) if r is not None else np.nan
-        if np.isnan(values).any():
+        if is_rate.any():
+            rated = property_column(table, "rating")
+            values[is_rate] = rated[is_rate]
+        if np.isnan(values[is_rate]).any():
             raise ValueError(
                 "rate event without a rating property "
                 "(DataSource.scala:66 MatchError parity)")

@@ -139,6 +139,12 @@ def shard_rows(seg_idx: np.ndarray, tgt_idx: np.ndarray, values: np.ndarray,
             seg_s[lo:hi] - s * seg_per_shard, tgt_s[lo:hi], val_s[lo:hi],
             w_s[lo:hi] if w_s is not None else None, row_len, seg_per_shard))
     r_max = max(t.shape[0] for t, _, _, _ in per_shard)
+    # bucket the row count so near-identical datasets (k-fold splits of
+    # one rating set differ by ~1/k rows) share ONE compiled program —
+    # without this an eval sweep pays folds x ranks separate XLA
+    # compiles, minutes on a TPU; padding rows carry w=0 and fold into
+    # the padding segment, so the math is unchanged
+    r_max = max(256, -(-r_max // 256) * 256)
 
     def _stack(idx, fill, dtype, shape_tail):
         out = np.full((n_shards, r_max) + shape_tail, fill, dtype=dtype)
@@ -226,6 +232,15 @@ class ALSData:
                 "put() requires process-contiguous device order")
 
         def commit_one(arr, sharding):
+            if isinstance(arr, jax.Array):
+                if arr.sharding == sharding:
+                    return arr      # already resident HERE (idempotent)
+                if multiproc:
+                    raise ValueError(
+                        "ALSData is resident on a different mesh; "
+                        "re-putting across meshes is not supported in "
+                        "multi-process runs")
+                return jax.device_put(arr, sharding)   # reshard
             if not multiproc:
                 return jax.device_put(arr, sharding)
             return jax.make_array_from_process_local_data(
@@ -460,6 +475,11 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
     n_shards = int(np.prod(mesh.devices.shape))
     assert data.by_user.tgt.shape[0] == n_shards, \
         f"data built for {data.by_user.tgt.shape[0]} shards, mesh has {n_shards}"
+    # commit the rows to the mesh (idempotent): every caller then feeds
+    # identically-sharded resident arrays, so one (params, dims) pair
+    # compiles exactly once per process regardless of entry path, and
+    # repeated calls never re-upload
+    data = data.put(mesh)
     dims = (data.n_users_pad, data.n_items_pad,
             data.by_user.seg_per_shard, data.by_item.seg_per_shard)
     key = jax.random.PRNGKey(params.seed)

@@ -56,14 +56,17 @@ def test_shard_rows_layout():
 
 
 def test_shard_rows_heavy_segment_spans_rows():
-    # one segment with 10 ratings at row_len=4 -> 3 rows, same seg id
+    # one segment with 10 ratings at row_len=4 -> 3 REAL rows, same seg
+    # id; the row count buckets up to 256 (compile-cache sharing across
+    # k-fold splits) with weight-0 padding rows
     seg = np.zeros(10, np.int64)
     tgt = np.arange(10)
     val = np.ones(10, np.float32)
     rows = shard_rows(seg, tgt, val, n_segments=1, n_shards=1, row_len=4)
-    assert rows.tgt.shape[1] == 3
-    assert (rows.seg[0] == 0).all()
-    assert rows.w[0].sum() == 10
+    assert rows.tgt.shape[1] == 256          # bucketed
+    assert (rows.seg[0, :3] == 0).all()      # the 3 real rows
+    assert rows.w[0, :3].sum() == 10
+    assert rows.w[0, 3:].sum() == 0          # padding carries no weight
 
 
 def test_als_reconstructs_low_rank():

@@ -949,10 +949,11 @@ class Suite:
                      f"(warm {pipeline.get('train_warm_s', '?')}s), query "
                      f"p50 {pipeline['query_p50_ms']}ms p99 "
                      f"{pipeline['query_p99_ms']}ms")
+        path = os.environ.get("BENCH_DETAILS_PATH") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_DETAILS.json")
         try:
-            with open(os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    "BENCH_DETAILS.json"), "w") as f:
+            with open(path, "w") as f:
                 json.dump({"devinfo": self.devinfo, "details": self.details,
                            "failures": self.failures, "mfu": mfus,
                            "baselines": self.baselines}, f, indent=1)
@@ -1029,10 +1030,12 @@ def orchestrate(names):
         old.kill()
         if platform != "cpu":
             # only the dedicated compile-phase marker — and only as the
-            # LAST heartbeat — triggers the bisect (a wedge in a later
-            # phase whose scrollback still shows the compile line must
-            # not silently swap the judged solve kernel)
-            last_hb = old.err_tail[-1] if old.err_tail else ""
+            # LAST HEARTBEAT (stderr also carries XLA warnings etc.) —
+            # triggers the bisect; a wedge in a later phase whose
+            # scrollback still shows the compile line must not silently
+            # swap the judged solve kernel
+            last_hb = next((ln for ln in reversed(old.err_tail)
+                            if ln.startswith("HB ")), "")
             bisect = "compile+warmup" in last_hb \
                 and "PIO_TPU_SOLVE" not in solve_env
             if bisect:

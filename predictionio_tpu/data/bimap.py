@@ -112,9 +112,26 @@ def assign_indices(values: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
 
     Returns (vocab, codes): `vocab` is the sorted array of distinct strings
     and `codes[i]` the index of `values[i]` in `vocab`. This replaces the
-    reference's collect-to-driver BiMap build with one `np.unique` pass and is
-    the scalable path for 20M-rating id spaces (SURVEY.md section 7 hard parts).
+    reference's collect-to-driver BiMap build (BiMap.scala:126-128) and is
+    the scalable path for 20M-rating id spaces (SURVEY.md section 7 hard
+    parts): hash-based pandas.factorize over the big array (O(n), no 20M
+    string sort) + a sort of only the DISTINCT values to keep the sorted-
+    vocab contract `vocab_index` relies on; numpy fallback otherwise.
     """
     arr = np.asarray(values)
-    vocab, codes = np.unique(arr, return_inverse=True)
-    return vocab, codes.astype(np.int32)
+    try:
+        import pandas as pd
+    except ImportError:
+        vocab, codes = np.unique(arr, return_inverse=True)
+        return vocab, codes.astype(np.int32)
+    raw_codes, uniques = pd.factorize(arr, sort=False)
+    if len(raw_codes) and raw_codes.min() < 0:
+        # factorize's NA sentinel is -1; rank[-1] would silently alias a
+        # null id onto a REAL vocab entry (the numpy path raises too)
+        raise ValueError("null/NaN id in values — every entity id must "
+                         "be a concrete string")
+    uniques = np.asarray(uniques)
+    order = np.argsort(uniques, kind="stable")   # distinct values only
+    rank = np.empty(len(order), np.int32)
+    rank[order] = np.arange(len(order), dtype=np.int32)
+    return uniques[order], rank[raw_codes]

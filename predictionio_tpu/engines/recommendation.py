@@ -181,8 +181,13 @@ class RecommendationDataSource(DataSource):
             if name != "rate":
                 values[events == name] = float(weights.get(name, 1.0))
         if is_rate.any():
-            rated = property_column(table, "rating")
-            values[is_rate] = rated[is_rate]
+            import pyarrow as pa
+
+            # parse ONLY the rate rows' properties (a mostly-implicit
+            # event log would otherwise json-parse millions of rows whose
+            # value the mask immediately discards)
+            values[is_rate] = property_column(
+                table.filter(pa.array(is_rate)), "rating")
         if np.isnan(values[is_rate]).any():
             raise ValueError(
                 "rate event without a rating property "

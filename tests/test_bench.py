@@ -1,0 +1,52 @@
+"""bench.py orchestrator contract tests.
+
+The driver runs `python bench.py` and records the LAST stdout line as the
+round's judged result — these tests lock that contract: exactly one final
+JSON line with the required keys, produced even when a config wedges its
+worker (the r03 failure mode: rc=124, no line, no diagnostics).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+
+
+def _run(only: str, deadline: str, timeout: int, tmp_path):
+    env = dict(os.environ)
+    env.update({"BENCH_PLATFORM": "cpu", "BENCH_DEADLINE_S": deadline,
+                # keep the repo's committed judged artifact untouched
+                "BENCH_DETAILS_PATH": str(tmp_path / "details.json")})
+    p = subprocess.run(
+        [sys.executable, BENCH, "--only", only],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    return p
+
+
+def test_bench_emits_single_json_line(tmp_path):
+    p = _run("naive_bayes_spam", "300", timeout=280, tmp_path=tmp_path)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert set(out) == {"metric", "value", "unit", "vs_baseline"}
+    assert out["metric"] == "judged_suite_wallclock"
+    assert out["value"] > 0
+    assert "naive_bayes_spam" in out["unit"]
+
+
+def test_bench_survives_wedged_worker_and_reports_partial(tmp_path):
+    """A config that hangs its worker (the hidden _sleep_forever wedge
+    simulator, budget 15s) must not take down the suite: the next config
+    still runs on a fresh worker and the final line still prints."""
+    p = _run("_sleep_forever,naive_bayes_spam", "300", timeout=280,
+             tmp_path=tmp_path)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "naive_bayes_spam" in out["unit"]      # measured despite wedge
+    assert "1/2" in out["unit"]                   # and the hole is visible
+    assert "TIMEOUT" in p.stderr

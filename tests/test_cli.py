@@ -1,0 +1,162 @@
+"""`pio` CLI lifecycle test — the quickstart CI analog at the CLI layer.
+
+The reference's integration harness drives the full lifecycle through the
+console (tests/pio_tests/scenarios/quickstart_test.py:33-95: app new ->
+import -> train -> query with asserted itemScores; basic_app_usecases.py:
+app/channel/accesskey CRUD). This runs the same surface in-process via
+click's CliRunner against a temp sqlite store.
+"""
+
+import json
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from predictionio_tpu.cli.main import cli
+
+
+@pytest.fixture()
+def clienv(tmp_path, monkeypatch):
+    """Point the env-var registry at a temp sqlite db, like pio-env.sh."""
+    from predictionio_tpu.data.eventstore import clear_cache
+    from predictionio_tpu.storage import Storage
+
+    for k, v in {
+        "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "pio",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "pio",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+    }.items():
+        monkeypatch.setenv(k, v)
+    Storage.reset()
+    clear_cache()
+    yield tmp_path
+    Storage.reset()
+    clear_cache()
+
+
+def _ok(result):
+    assert result.exit_code == 0, result.output
+    return result.output
+
+
+def test_cli_full_lifecycle(clienv, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    r = CliRunner()
+
+    out = _ok(r.invoke(cli, ["version"]))
+    assert out.strip()
+    _ok(r.invoke(cli, ["status"]))
+
+    # app + accesskey + channel CRUD (basic_app_usecases.py surface)
+    out = _ok(r.invoke(cli, ["app", "new", "cliapp", "--access-key", "CK"]))
+    assert "cliapp" in out and "CK" in out
+    assert "cliapp" in _ok(r.invoke(cli, ["app", "list"]))
+    assert "CK" in _ok(r.invoke(cli, ["accesskey", "list"]))
+    _ok(r.invoke(cli, ["app", "channel-new", "cliapp", "side"]))
+    assert "side" in _ok(r.invoke(cli, ["app", "show", "cliapp"]))
+
+    # import: JSON-lines events (FileToEvents.scala:40 analog)
+    rng = np.random.default_rng(0)
+    events_file = tmp_path / "events.json"
+    with open(events_file, "w") as f:
+        for _ in range(600):
+            u, i = rng.integers(0, 25), rng.integers(0, 30)
+            f.write(json.dumps({
+                "event": "rate", "entityType": "user", "entityId": f"u{u}",
+                "targetEntityType": "item", "targetEntityId": f"i{i}",
+                "properties": {"rating": float(rng.integers(1, 6))},
+                "eventTime": "2026-01-02T03:04:05.000Z"}) + "\n")
+    out = _ok(r.invoke(cli, ["import", "--appname", "cliapp",
+                             "--input", str(events_file)]))
+    assert "Imported 600 events" in out
+
+    # scaffold + train (quickstart_test.py:33-95 analog)
+    _ok(r.invoke(cli, ["template", "get", "recommendation", "."]))
+    variant = json.loads((tmp_path / "engine.json").read_text())
+    variant["datasource"]["params"]["app_name"] = "cliapp"
+    variant["algorithms"][0]["params"].update(
+        {"rank": 6, "num_iterations": 5})
+    (tmp_path / "engine.json").write_text(json.dumps(variant))
+    out = _ok(r.invoke(cli, ["train"]))
+    assert "Training completed" in out
+
+    # batch scoring (BatchPredict.scala:71 analog)
+    queries = tmp_path / "queries.json"
+    queries.write_text("\n".join(
+        json.dumps({"user": f"u{u}", "num": 3}) for u in range(5)))
+    preds = tmp_path / "preds.json"
+    out = _ok(r.invoke(cli, ["batchpredict", "--input", str(queries),
+                             "--output", str(preds)]))
+    assert "Wrote 5 predictions" in out
+    lines = [json.loads(ln) for ln in preds.read_text().splitlines()]
+    assert len(lines) == 5
+    for ln in lines:
+        assert len(ln["prediction"]["itemScores"]) == 3   # quickstart assert
+
+    # export round-trips the imported events
+    exported = tmp_path / "export.json"
+    out = _ok(r.invoke(cli, ["export", "--appname", "cliapp",
+                             "--output", str(exported), "--format", "json"]))
+    n = len([ln for ln in exported.read_text().splitlines() if ln.strip()])
+    assert n == 600
+
+
+def test_cli_import_requires_app(clienv, tmp_path):
+    r = CliRunner()
+    bad = tmp_path / "nope.json"
+    bad.write_text("")
+    res = r.invoke(cli, ["import", "--appname", "ghost",
+                         "--input", str(bad)])
+    assert res.exit_code == 1
+    assert "ghost" in res.output or "ERROR" in res.output
+
+
+def test_cli_eval_sweep(clienv, tmp_path, monkeypatch):
+    """`pio eval <Evaluation> <ParamsGenerator>` (Console.scala:232):
+    the user-module reflection path + best.json output."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    r = CliRunner()
+    _ok(r.invoke(cli, ["app", "new", "evalapp", "--access-key", "EK"]))
+
+    rng = np.random.default_rng(1)
+    events_file = tmp_path / "ev.json"
+    with open(events_file, "w") as f:
+        for _ in range(400):
+            u, i = rng.integers(0, 20), rng.integers(0, 25)
+            f.write(json.dumps({
+                "event": "rate", "entityType": "user", "entityId": f"u{u}",
+                "targetEntityType": "item", "targetEntityId": f"i{i}",
+                "properties": {"rating": float(rng.integers(1, 6))}}) + "\n")
+    _ok(r.invoke(cli, ["import", "--appname", "evalapp",
+                       "--input", str(events_file)]))
+
+    (tmp_path / "my_eval.py").write_text(
+        "from predictionio_tpu.core.evaluation import ("
+        "Evaluation, EngineParamsGenerator)\n"
+        "from predictionio_tpu.engines.recommendation import ("
+        "engine, default_engine_params, PrecisionAtK, DataSourceParams)\n"
+        "\n\n"
+        "class MyEval(Evaluation):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(engine=engine(), metric=PrecisionAtK(k=3))\n"
+        "\n\n"
+        "class MyParams(EngineParamsGenerator):\n"
+        "    def _params(rank):\n"
+        "        p = default_engine_params('evalapp', rank=rank,\n"
+        "                                  num_iterations=3)\n"
+        "        p.data_source_params.eval_params = {'kFold': 2,\n"
+        "                                            'queryNum': 3}\n"
+        "        return p\n"
+        "    engine_params_list = [_params(4), _params(6)]\n")
+
+    out = _ok(r.invoke(cli, ["eval", "my_eval.MyEval", "my_eval.MyParams"]))
+    assert "Evaluation completed" in out
+    best = json.loads((tmp_path / "best.json").read_text())
+    assert best["algorithms"][0]["params"]["rank"] in (4, 6)

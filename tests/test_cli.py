@@ -160,3 +160,73 @@ def test_cli_eval_sweep(clienv, tmp_path, monkeypatch):
     assert "Evaluation completed" in out
     best = json.loads((tmp_path / "best.json").read_text())
     assert best["algorithms"][0]["params"]["rank"] in (4, 6)
+
+
+def test_cli_deploy_serves_and_stops(clienv, tmp_path, monkeypatch):
+    """`pio deploy` as a REAL process (CreateServer.scala:109 analog):
+    bind, answer /queries.json with itemScores, undeploy via /stop."""
+    import os
+    import socket
+    import subprocess
+    import sys as _sys
+    import time
+    import urllib.request
+
+    monkeypatch.chdir(tmp_path)
+    r = CliRunner()
+    _ok(r.invoke(cli, ["app", "new", "depapp", "--access-key", "DK"]))
+    rng = np.random.default_rng(2)
+    events_file = tmp_path / "ev.json"
+    with open(events_file, "w") as f:
+        for _ in range(400):
+            u, i = rng.integers(0, 20), rng.integers(0, 25)
+            f.write(json.dumps({
+                "event": "rate", "entityType": "user", "entityId": f"u{u}",
+                "targetEntityType": "item", "targetEntityId": f"i{i}",
+                "properties": {"rating": float(rng.integers(1, 6))}}) + "\n")
+    _ok(r.invoke(cli, ["import", "--appname", "depapp",
+                       "--input", str(events_file)]))
+    _ok(r.invoke(cli, ["template", "get", "recommendation", "."]))
+    variant = json.loads((tmp_path / "engine.json").read_text())
+    variant["datasource"]["params"]["app_name"] = "depapp"
+    variant["algorithms"][0]["params"].update({"rank": 4,
+                                               "num_iterations": 3})
+    (tmp_path / "engine.json").write_text(json.dumps(variant))
+    _ok(r.invoke(cli, ["train"]))
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "predictionio_tpu.cli.main", "deploy",
+         "--port", str(port), "--accesskey", "DK"],
+        cwd=tmp_path, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        body = None
+        for _ in range(120):                   # server + jax cold start
+            time.sleep(1)
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"deploy died: {proc.stdout.read()[-2000:]}")
+            try:
+                req = urllib.request.Request(
+                    f"http://localhost:{port}/queries.json",
+                    data=json.dumps({"user": "u1", "num": 3}).encode(),
+                    headers={"Content-Type": "application/json"})
+                body = json.loads(urllib.request.urlopen(req, timeout=5)
+                                  .read())
+                break
+            except OSError:
+                continue
+        assert body and len(body["itemScores"]) == 3, body
+        # undeploy via /stop with the access key (CreateServer.scala:635)
+        req = urllib.request.Request(
+            f"http://localhost:{port}/stop?accessKey=DK", data=b"")
+        urllib.request.urlopen(req, timeout=5)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -35,6 +35,7 @@ from predictionio_tpu.core.params import Params
 from predictionio_tpu.data.bimap import vocab_index
 from predictionio_tpu.ops.linalg import batched_spd_solve
 from predictionio_tpu.ops.segment import rows_gram_rhs, segment_count
+from predictionio_tpu.ops.topk import host_topk as _host_topk
 
 
 @dataclasses.dataclass
@@ -480,6 +481,17 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
     # compiles exactly once per process regardless of entry path, and
     # repeated calls never re-upload
     data = data.put(mesh)
+    multihost = jax.process_count() > 1
+
+    def gather_host(arr, n_rows):
+        """Full host copy of a (possibly cross-host-sharded) factor
+        matrix — every host needs it for serving/persistence."""
+        if multihost:
+            from jax.experimental.multihost_utils import process_allgather
+
+            return np.asarray(process_allgather(arr, tiled=True))[:n_rows]
+        return np.asarray(jax.device_get(arr))[:n_rows]
+
     dims = (data.n_users_pad, data.n_items_pad,
             data.by_user.seg_per_shard, data.by_item.seg_per_shard)
     key = jax.random.PRNGKey(params.seed)
@@ -495,7 +507,7 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
         snap = checkpointer.latest(fingerprint=fp)
         it = 0
         V = None
-        if jax.process_count() > 1:
+        if multihost:
             # the resume decision must be IDENTICAL on every host or the
             # SPMD programs diverge (some resuming, some from scratch);
             # process 0's snapshot is authoritative — snapshot dirs are
@@ -534,33 +546,18 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
             U, V = chunk(bu, bi, V)
             it += n
             if it < params.num_iterations:
-                if jax.process_count() > 1:
+                if multihost:
                     # V is sharded across hosts: snapshot the gathered
                     # copy, and only process 0 writes (every process
                     # writing the same file would race)
-                    from jax.experimental.multihost_utils import (
-                        process_allgather)
-
-                    v_host = np.asarray(
-                        process_allgather(V, tiled=True))[:data.n_items]
+                    v_host = gather_host(V, data.n_items)
                     if jax.process_index() == 0:
                         checkpointer.save(it, {"V": v_host},
                                           fingerprint=fp)
                 else:
                     checkpointer.save(it, {"V": V[:data.n_items]},
                                       fingerprint=fp)
-    if jax.process_count() > 1:
-        # factors come back sharded over all hosts' devices; every host
-        # needs the full matrices (serving/persistence) — one tiled
-        # all-gather over the distributed runtime
-        from jax.experimental.multihost_utils import process_allgather
-
-        U = np.asarray(process_allgather(U, tiled=True))[:data.n_users]
-        V = np.asarray(process_allgather(V, tiled=True))[:data.n_items]
-        return U, V
-    U = np.asarray(jax.device_get(U))[:data.n_users]
-    V = np.asarray(jax.device_get(V))[:data.n_items]
-    return U, V
+    return gather_host(U, data.n_users), gather_host(V, data.n_items)
 
 
 # ---------------------------------------------------------------------------
@@ -584,9 +581,6 @@ def _topk_scores_batch_nomask(user_vecs: jax.Array, V: jax.Array,
     quickstart shape, tests/pio_tests/scenarios/quickstart_test.py:86) never
     carry black/white lists."""
     return jax.lax.top_k(user_vecs @ V.T, num)
-
-
-from predictionio_tpu.ops.topk import host_topk as _host_topk
 
 
 #: measured seconds for one tiny jitted dispatch + fetch on the default

@@ -30,7 +30,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.core.params import Params
-from predictionio_tpu.ops.attention import blockwise_attention
+from predictionio_tpu.ops.attention import (
+    blockwise_attention, ring_attention_traced,
+)
 
 
 @dataclasses.dataclass
@@ -45,6 +47,11 @@ class SeqRecParams(Params):
     batch_size: int = 128
     epochs: int = 10
     seed: int = 7
+    #: "flash" (local blockwise kernel) or "ring" (sequence parallelism:
+    #: K/V blocks rotate over the mesh's "seq" axis via ppermute — sp for
+    #: sessions longer than one chip's HBM). "ring" requires training on
+    #: a mesh with a "seq" axis; serving always uses the local kernel.
+    attention_impl: str = "flash"
 
 
 def init_params(rng: np.random.Generator, n_items: int, p: SeqRecParams,
@@ -86,29 +93,50 @@ def _layer_norm(x, ln):
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * ln["scale"] + ln["bias"]
 
 
-def forward(params: Dict, seqs: jax.Array, n_heads: int) -> jax.Array:
-    """[B, L] int32 item ids (0 = pad) -> [B, L, D] hidden states."""
+def forward(params: Dict, seqs: jax.Array, n_heads: int,
+            mesh: Optional[Mesh] = None,
+            attention_impl: str = "flash") -> jax.Array:
+    """[B, L] int32 item ids (0 = pad) -> [B, L, D] hidden states.
+
+    attention_impl="ring" + a mesh with a "seq" axis runs the attention
+    sequence-parallel (ring_attention_traced): each device holds L/p of
+    the sequence and K/V blocks rotate via ppermute — exact, O(L/p) HBM
+    per device."""
     b, l = seqs.shape
     d = params["emb"].shape[1]
     h = params["emb"][seqs] + params["pos"][None, :l]
     pad = (seqs == 0)[..., None]
     key_mask = seqs != 0       # left-padding sits in the causal PAST; the
+    if attention_impl not in ("flash", "ring"):
+        raise ValueError(f"unknown attention_impl {attention_impl!r}: "
+                         "expected 'flash' or 'ring'")
+    use_ring = (attention_impl == "ring" and mesh is not None
+                and "seq" in mesh.axis_names)
+    if attention_impl == "ring" and not use_ring:
+        raise ValueError('attention_impl="ring" requires a mesh with a '
+                         '"seq" axis')
     for layer in params["layers"]:  # key mask keeps it out of the softmax
         x = _layer_norm(h, layer["ln1"])
         qkv = x @ layer["wqkv"]                       # [B, L, 3D] MXU
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(b, l, n_heads, d // n_heads)
-        att = blockwise_attention(split(q), split(k), split(v), causal=True,
-                                  key_mask=key_mask)
+        if use_ring:
+            att = ring_attention_traced(
+                split(q), split(k), split(v), mesh, axis="seq",
+                causal=True, key_mask=key_mask)
+        else:
+            att = blockwise_attention(split(q), split(k), split(v),
+                                      causal=True, key_mask=key_mask)
         h = h + att.reshape(b, l, d) @ layer["wo"]
         x = _layer_norm(h, layer["ln2"])
         h = h + jax.nn.gelu(x @ layer["w1"]) @ layer["w2"]
     return jnp.where(pad, 0.0, _layer_norm(h, params["ln_f"]))
 
 
-def _loss_fn(params, seqs, targets, n_heads):
+def _loss_fn(params, seqs, targets, n_heads, mesh=None,
+             attention_impl="flash"):
     """Next-item softmax cross-entropy, tied output embedding, pad-masked."""
-    hidden = forward(params, seqs, n_heads)           # [B, L, D]
+    hidden = forward(params, seqs, n_heads, mesh, attention_impl)  # [B,L,D]
     logits = hidden @ params["emb"].T                 # [B, L, V] MXU
     mask = (targets > 0).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -122,12 +150,15 @@ def make_train_step(mesh: Optional[Mesh], p: SeqRecParams, optimizer):
 
     def step(params, opt_state, seqs, targets):
         if mesh is not None and "data" in mesh.axis_names:
-            seqs = jax.lax.with_sharding_constraint(
-                seqs, NamedSharding(mesh, P("data", None)))
-            targets = jax.lax.with_sharding_constraint(
-                targets, NamedSharding(mesh, P("data", None)))
+            # with ring attention the sequence dim lives on "seq"; laying
+            # the tokens out that way up front saves XLA a full reshard
+            seq_dim = "seq" if ("seq" in mesh.axis_names
+                                and p.attention_impl == "ring") else None
+            sh = NamedSharding(mesh, P("data", seq_dim))
+            seqs = jax.lax.with_sharding_constraint(seqs, sh)
+            targets = jax.lax.with_sharding_constraint(targets, sh)
         loss, grads = jax.value_and_grad(_loss_fn)(
-            params, seqs, targets, p.n_heads)
+            params, seqs, targets, p.n_heads, mesh, p.attention_impl)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda w, u: w + u, params, updates)
         return params, opt_state, loss

@@ -139,9 +139,11 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return _flash_finish(o, l, q.dtype)
 
 
-def _ring_attention_local(q, k, v, key_mask, *, axis: str, causal: bool):
+def _ring_attention_local(q, k, v, key_mask, *, axis: str, causal: bool,
+                          batch_axis: Optional[str] = None):
     """shard_map body: q/k/v are the LOCAL sequence shards [B, L/p, H, D];
-    key_mask the matching [B, L/p] bool shard (False = padding key)."""
+    key_mask the matching [B, L/p] bool shard (False = padding key). With
+    a batch_axis, B is also the local batch shard (dp x sp)."""
     p_size = jax.lax.psum(1, axis)
     r = jax.lax.axis_index(axis)
     b, lq, h, d = q.shape
@@ -162,10 +164,13 @@ def _ring_attention_local(q, k, v, key_mask, *, axis: str, causal: bool):
         km_t = jax.lax.ppermute(km_t, axis, perm)
         return (o, m, l, k_t, v_t, km_t), None
 
-    # zero-init carries must be marked device-varying over the ring axis or
-    # scan rejects the carry type under shard_map
+    # zero-init carries must be marked device-varying over every mesh axis
+    # the inputs vary over (the ring axis, plus the batch axis under
+    # dp x sp) or scan rejects the carry type under shard_map
+    vary_axes = (axis,) if batch_axis is None else (axis, batch_axis)
+
     def _vary(x):
-        return jax.lax.pcast(x, (axis,), to="varying")
+        return jax.lax.pcast(x, vary_axes, to="varying")
 
     o0 = _vary(jnp.zeros((b, h, lq, d), jnp.float32))
     m0 = _vary(jnp.full((b, h, lq), NEG_INF, jnp.float32))
@@ -173,6 +178,21 @@ def _ring_attention_local(q, k, v, key_mask, *, axis: str, causal: bool):
     (o, _, l, _, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v, key_mask), jnp.arange(p_size))
     return _flash_finish(o, l, q.dtype)
+
+
+def _batch_axis_of(mesh: Mesh, seq_axis: str) -> Optional[str]:
+    """The mesh axis to shard the BATCH dim over inside the ring/Ulysses
+    shard_map — "data" when present (dp composes with sp: each data row
+    runs its own ring), else replicated."""
+    return "data" if ("data" in mesh.axis_names
+                      and seq_axis != "data") else None
+
+
+def _check_seq_divisible(q, mesh, axis):
+    if q.shape[1] % mesh.shape[axis]:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by mesh axis "
+            f"'{axis}' size {mesh.shape[axis]}")
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
@@ -184,33 +204,54 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     its L/p query rows and streams all p K/V blocks through the flash
     recurrence, passing blocks around the ring with ``ppermute`` — peak HBM
     is O(L/p * D) per device, enabling sequences p× longer than one chip
-    holds. Returns output sharded the same way.
+    holds. Returns output sharded the same way. (Host-level entry: places
+    the operands, then delegates to ``ring_attention_traced``.)
     """
-    if q.shape[1] % mesh.shape[axis]:
-        raise ValueError(
-            f"seq len {q.shape[1]} not divisible by mesh axis "
-            f"'{axis}' size {mesh.shape[axis]}")
-    fn = _sharded_fn(_ring_attention_local, mesh, axis, causal)
-    sharding = NamedSharding(mesh, P(None, axis, None, None))
+    _check_seq_divisible(q, mesh, axis)
+    ba = _batch_axis_of(mesh, axis)
+    sharding = NamedSharding(mesh, P(ba, axis, None, None))
     if key_mask is None:
         key_mask = jnp.ones(q.shape[:2], bool)
-    km = jax.device_put(key_mask, NamedSharding(mesh, P(None, axis)))
-    return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
-              jax.device_put(v, sharding), km)
+    km = jax.device_put(key_mask, NamedSharding(mesh, P(ba, axis)))
+    return ring_attention_traced(
+        jax.device_put(q, sharding), jax.device_put(k, sharding),
+        jax.device_put(v, sharding), mesh, axis, causal, km)
+
+
+def ring_attention_traced(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mesh: Mesh, axis: str = "seq",
+                          causal: bool = False,
+                          key_mask: Optional[jax.Array] = None) -> jax.Array:
+    """`ring_attention` callable from INSIDE a jitted program (a training
+    step): no host-side device_put — the shard_map in_specs act as
+    sharding constraints and GSPMD inserts the reshard. The batch dim
+    shards over "data" when the mesh has one (dp x sp composition). Used
+    by the sessionrec train step's sp path (models/seqrec.py)."""
+    _check_seq_divisible(q, mesh, axis)
+    if key_mask is None:
+        key_mask = jnp.ones(q.shape[:2], bool)
+    fn = _sharded_fn(_ring_attention_local, mesh, axis, causal,
+                     _batch_axis_of(mesh, axis))
+    return fn(q, k, v, key_mask)
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_fn(local_fn, mesh: Mesh, axis: str, causal: bool):
-    """Cache the jitted shard_map wrapper per (mesh, axis, causal) so
-    repeated calls reuse the compiled executable instead of re-tracing."""
-    spec = P(None, axis, None, None)
-    mask_spec = P(None, axis)
+def _sharded_fn(local_fn, mesh: Mesh, axis: str, causal: bool,
+                batch_axis: Optional[str] = None):
+    """Cache the jitted shard_map wrapper per (mesh, axis, causal,
+    batch_axis) so repeated calls reuse the compiled executable instead of
+    re-tracing. `batch_axis` additionally shards the batch dim (dp
+    composed with the sequence collective, which only spans `axis`)."""
+    spec = P(batch_axis, axis, None, None)
+    mask_spec = P(batch_axis, axis)
     return jax.jit(jax.shard_map(
-        functools.partial(local_fn, axis=axis, causal=causal),
+        functools.partial(local_fn, axis=axis, causal=causal,
+                          batch_axis=batch_axis),
         mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec))
 
 
-def _ulysses_local(q, k, v, key_mask, *, axis: str, causal: bool):
+def _ulysses_local(q, k, v, key_mask, *, axis: str, causal: bool,
+                   batch_axis=None):  # batch_axis: spec-only, unused here
     """shard_map body: reshard seq-sharded -> head-sharded, dense attention
     on the full sequence for the local head group, reshard back. The key
     mask is all-gathered to full length (tiny: [B, L] bool)."""

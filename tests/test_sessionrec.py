@@ -148,3 +148,38 @@ def test_sessionrec_resume_rejects_mismatched_opt_state(tmp_path, caplog):
         model = train_seqrec(None, sessions, p5, checkpointer=ck)
     assert model.recommend_next(["i0", "i1"], 2)
     assert any("RESET adam moments" in r.message for r in caplog.records)
+
+
+def test_sessionrec_ring_attention_matches_flash(mesh8):
+    """attention_impl="ring" (sequence parallelism over a "seq" axis) is
+    exact: same data + seed must reproduce the flash-trained model."""
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.models.seqrec import SeqRecParams, train_seqrec
+
+    sessions = [[f"i{(s + j) % 10}" for j in range(8)] for s in range(24)]
+    base = dict(d_model=16, n_heads=2, n_layers=1, max_len=8, epochs=2,
+                batch_size=8)
+    flash = train_seqrec(None, sessions, SeqRecParams(**base))
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                axis_names=("data", "seq"))
+    ring = train_seqrec(mesh, sessions,
+                        SeqRecParams(**base, attention_impl="ring"))
+    np.testing.assert_allclose(
+        np.asarray(ring.params["emb"]), np.asarray(flash.params["emb"]),
+        atol=2e-4)
+    recs = ring.recommend_next(["i2", "i3"], 3)
+    assert recs
+
+
+def test_sessionrec_ring_requires_seq_axis():
+    from predictionio_tpu.models.seqrec import SeqRecParams, train_seqrec
+
+    sessions = [["a", "b", "c"] for _ in range(4)]
+    with pytest.raises(ValueError, match="seq"):
+        train_seqrec(None, sessions,
+                     SeqRecParams(d_model=8, n_heads=2, n_layers=1,
+                                  max_len=8, epochs=1, batch_size=4,
+                                  attention_impl="ring"))

@@ -333,3 +333,46 @@ def test_view_event_training_variant(tmp_path):
     finally:
         Storage.reset()
         clear_cache()
+
+
+async def test_feedback_loop_records_events(app_with_ratings):
+    """--feedback (CreateServer.scala:527-589 parity): each served query
+    writes a 'predict' event carrying prId + query + prediction back into
+    the event store, queryable for offline prediction-quality analysis."""
+    engine = engine_factory()
+    instance = run_train(engine, default_engine_params(
+        "MyApp1", rank=4, num_iterations=3))
+    result, ctx = load_for_deploy(engine, instance)
+    server = create_query_server(engine, result, instance, ctx,
+                                 feedback=True, feedback_app_name="MyApp1")
+    client = TestClient(TestServer(server.app))
+    await client.start_server()
+    try:
+        resp = await client.post("/queries.json",
+                                 json={"user": "u1", "num": 3})
+        assert resp.status == 200
+        body = await resp.json()
+        assert len(body["itemScores"]) == 3
+        pr_id = body.get("prId")
+        assert pr_id, "feedback-tagged responses must carry prId"
+        # the recorder runs in an executor; drain it
+        import asyncio
+
+        for _ in range(50):
+            recorded = list(Storage.get_events().find(
+                instance_app_id(), entity_type="pio_pr"))
+            if recorded:
+                break
+            await asyncio.sleep(0.1)
+        assert recorded, "no feedback event recorded"
+        ev = recorded[-1]
+        assert ev.event == "predict" and ev.entity_id == pr_id
+        assert ev.properties.get("prediction")["itemScores"]
+    finally:
+        await client.close()
+
+
+def instance_app_id():
+    from predictionio_tpu.data.eventstore import resolve_app
+
+    return resolve_app("MyApp1")[0]

@@ -829,8 +829,9 @@ def resolve_platform() -> str:
 
 
 class Suite:
-    def __init__(self, names, deadline_s):
+    def __init__(self, names, deadline_s, partial=False):
         self.names = names
+        self.partial = partial
         self.deadline = time.monotonic() + deadline_s
         self.details = []
         self.failures = []
@@ -949,9 +950,12 @@ class Suite:
                      f"(warm {pipeline.get('train_warm_s', '?')}s), query "
                      f"p50 {pipeline['query_p50_ms']}ms p99 "
                      f"{pipeline['query_p99_ms']}ms")
+        # --only (subset) runs must not clobber the canonical full-suite
+        # artifact the judge reads — they get a .partial sibling
+        name = ("BENCH_DETAILS.json" if not self.partial
+                else "BENCH_DETAILS.partial.json")
         path = os.environ.get("BENCH_DETAILS_PATH") or os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "BENCH_DETAILS.json")
+            os.path.dirname(os.path.abspath(__file__)), name)
         try:
             with open(path, "w") as f:
                 json.dump({"devinfo": self.devinfo, "details": self.details,
@@ -967,9 +971,9 @@ class Suite:
         }), flush=True)
 
 
-def orchestrate(names):
+def orchestrate(names, partial=False):
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 1500))
-    suite = Suite(names, deadline_s)
+    suite = Suite(names, deadline_s, partial=partial)
 
     def _sigterm(_sig, _frm):
         log("SIGTERM — dumping partial results")
@@ -1116,7 +1120,7 @@ def main():
         if unknown:
             log(f"unknown config(s) {unknown}; known: {list(CONFIGS)}")
             sys.exit(2)
-    orchestrate(names)
+    orchestrate(names, partial=bool(args.only))
 
 
 if __name__ == "__main__":

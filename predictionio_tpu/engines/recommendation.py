@@ -169,7 +169,8 @@ class RecommendationDataSource(DataSource):
             app_name=self.params.app_name,
             entity_type="user",
             event_names=names,
-            target_entity_type="item")
+            target_entity_type="item",
+            ordered=False)     # rating math is permutation-invariant
         events = np.asarray(table.column("event").to_pylist(), dtype=object)
         users = np.asarray(table.column("entity_id").to_pylist(),
                            dtype=object)

@@ -366,11 +366,15 @@ class EventStore(abc.ABC):
         return out
 
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
-                      **filters):
+                      ordered: bool = True, **filters):
         """Training-path read: events as a pyarrow.Table (PEvents.find analog).
 
-        Default implementation materializes through `find`; columnar backends
-        override with a direct scan.
+        ``ordered=False`` is a hint that the caller (a training read whose
+        math is permutation-invariant — the JdbcRDD-partition contract)
+        accepts ARBITRARY row order; backends may then skip the time sort.
+        The default keeps the row path's chronological guarantee (exports,
+        dumps). Default implementation materializes through `find`;
+        columnar backends override with a direct scan.
         """
         from predictionio_tpu.data.columnar import events_to_table
         return events_to_table(self.find(app_id, channel_id, **filters))

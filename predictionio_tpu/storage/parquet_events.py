@@ -222,6 +222,7 @@ class ParquetEvents(base.EventStore):
         self,
         app_id: int,
         channel_id: Optional[int] = None,
+        ordered: bool = True,   # hint only: this backend always sorts
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
         entity_type: Optional[str] = None,

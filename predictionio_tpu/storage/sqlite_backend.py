@@ -205,6 +205,7 @@ class SqliteEvents(base.EventStore):
         target_entity_id=UNFILTERED,
         limit: Optional[int] = None,
         reversed_order: bool = False,
+        ordered: bool = True,
     ):
         """(sql, params) for a filtered event scan — shared by the row
         path (`find`) and the columnar training path (`find_columnar`)."""
@@ -238,9 +239,9 @@ class SqliteEvents(base.EventStore):
             else:
                 where.append("targetEntityId = ?")
                 params.append(target_entity_id)
-        order = "DESC" if reversed_order else "ASC"
-        sql = (f"SELECT {select_cols} FROM {name} "
-               f"WHERE {' AND '.join(where)} ORDER BY eventTime {order}")
+        sql = f"SELECT {select_cols} FROM {name} WHERE {' AND '.join(where)}"
+        if ordered:
+            sql += f" ORDER BY eventTime {'DESC' if reversed_order else 'ASC'}"
         if limit is not None and limit >= 0:
             sql += " LIMIT ?"
             params.append(limit)
@@ -259,18 +260,23 @@ class SqliteEvents(base.EventStore):
             yield _row_to_event(row)
 
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
-                      **filters):
+                      ordered: bool = True, **filters):
         """Direct columnar scan -> pyarrow.Table, skipping per-row Event/
         DataMap materialization (the JDBCPEvents.scala:35 training-read
         analog: SQL straight into the columnar buffers that feed device
-        arrays). ~5x faster than the row path at 100k events."""
+        arrays). ``ordered=False`` (training reads) additionally drops
+        the global time sort. ``reversed_order``/``limit`` semantics
+        require the sort, so they force it back on."""
         import pyarrow as pa
 
         from predictionio_tpu.data.columnar import EVENT_SCHEMA
 
+        if filters.get("reversed_order") or "limit" in filters:
+            ordered = True
         cols = ("id, event, entityType, entityId, targetEntityType, "
                 "targetEntityId, properties, eventTime, creationTime")
-        sql, params = self._find_sql(cols, app_id, channel_id, **filters)
+        sql, params = self._find_sql(cols, app_id, channel_id,
+                                     ordered=ordered, **filters)
         try:
             rows = self.client.conn().execute(sql, params).fetchall()
         except sqlite3.OperationalError as ex:

@@ -852,6 +852,12 @@ class Suite:
             tail = w.err_tail[-3:]
             log(f"worker init on {platform} FAILED/hung "
                 f"(last heartbeats: {tail})")
+            # the artifact must explain on its own why the suite ran on
+            # a fallback platform (r03's silent claim-hang lesson)
+            self.failures.append({
+                "name": f"_worker_init_{platform}",
+                "error": "backend init hung/failed (device claim)",
+                "last_heartbeats": tail})
             w.kill()
             return None
         self.devinfo = json.loads(line[len("DEVINFO "):])

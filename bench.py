@@ -17,8 +17,10 @@ Design (round-4 rebuild; BENCH_r03 post-mortem):
   train, query) to stderr; the orchestrator echoes them and keeps the
   tail, so a hang always leaves evidence of WHERE.
 * Watchdogs: per-config budgets + an overall deadline (BENCH_DEADLINE_S,
-  default 1500s — the driver's own timeout killed the r03 suite, so the
-  suite now ends itself first and always prints its final line). SIGTERM
+  default 3300s: the 2640s summed per-config budgets + 420s worker init
+  + slack, so the tail config is never deadline-skipped — the driver's
+  own timeout killed the r03 suite, so the suite ends itself and always
+  prints its final line; an outer SIGTERM still dumps partials). SIGTERM
   dumps partial results instead of dying silently.
 * Fallback ladder: TPU worker init hangs -> one retry -> CPU worker for
   whatever remains. A config that wedges the TPU worker is retried on
@@ -978,7 +980,13 @@ class Suite:
 
 
 def orchestrate(names, partial=False):
-    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 1500))
+    # default covers the summed per-config budgets (2640s) PLUS worker
+    # init (INIT_BUDGET_S=420, possibly retried) so the tail config
+    # (als_ml20m, the north star) is not skipped as "suite deadline" on a
+    # slow-but-healthy chip; a pathologically slow claim + retry can still
+    # eat into the tail, and if an outer driver timeout fires first the
+    # SIGTERM handler dumps partials
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 3300))
     suite = Suite(names, deadline_s, partial=partial)
 
     def _sigterm(_sig, _frm):

@@ -58,11 +58,14 @@ def cooccurrence_topn(mesh, user_idx: np.ndarray, item_idx: np.ndarray,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    n_dev = int(np.prod(mesh.devices.shape))
     axis = mesh.axis_names[0]
+    # shard_map below shards over the FIRST mesh axis only (other axes
+    # replicate), so block geometry must follow that axis's size — the
+    # total device count mis-addresses the diagonal on multi-axis meshes
+    n_shards = int(mesh.shape[axis])
     k = int(min(n_top, n_items))
 
-    if n_dev == 1 and jax.default_backend() == "cpu":
+    if int(np.prod(mesh.devices.shape)) == 1 and jax.default_backend() == "cpu":
         # single-device CPU fallback: BLAS syrk exploits the symmetry of
         # A^T A (half the FLOPs); XLA lowers it to a generic gemm and
         # loses 2x. The dispatch-aware backend pick mirrors the serving
@@ -75,18 +78,18 @@ def cooccurrence_topn(mesh, user_idx: np.ndarray, item_idx: np.ndarray,
         np.fill_diagonal(c, 0.0)
         return host_topk(c, k)
 
-    # pad items to a multiple of 128 lanes x device count: zero columns
+    # pad items to a multiple of 128 lanes x shard count: zero columns
     # count nothing and padded rows are sliced off after the gather
-    blk = -(-n_items // (128 * n_dev)) * 128
-    ni_pad = blk * n_dev
+    blk = -(-n_items // (128 * n_shards)) * 128
+    ni_pad = blk * n_shards
 
     a = np.zeros((n_users, ni_pad), np.float32)
     a[user_idx, item_idx] = 1.0
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() in ("tpu", "axon"):
         a = a.astype(jnp.bfloat16)      # exact for 0/1; halves the upload;
         # f32 elsewhere: CPU XLA emulates bf16 matmuls slowly
 
-    run = _sharded_topn_fn(mesh, axis, n_dev, blk, ni_pad, k)
+    run = _sharded_topn_fn(mesh, axis, n_shards, blk, ni_pad, k)
     a_dev = jax.device_put(a, NamedSharding(mesh, P(None, axis)))
     vals, idx = jax.device_get(run(a_dev))
     return np.asarray(vals)[:n_items], np.asarray(idx)[:n_items]
@@ -171,10 +174,11 @@ def train_cooccurrence(user_idx: np.ndarray, item_idx: np.ndarray,
     # budget check BEFORE any jax backend init (jax.devices() claims the
     # chip — pointless and potentially minutes-slow over a tunnel when
     # the host fallback is going to run anyway). The padded width is what
-    # actually gets allocated/replicated: [n_users, ni_pad] at 128-lane x
-    # device-count blocks, plus the [n_items, n_items] count matrix.
-    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
-    ni_pad = -(-n_items // (128 * n_dev)) * 128 * n_dev
+    # actually gets allocated/replicated: [n_users, ni_pad] at 128-lane
+    # blocks per FIRST-axis shard (shard_map shards that axis only), plus
+    # the [n_items, n_items] count matrix.
+    n_shards = int(mesh.shape[mesh.axis_names[0]]) if mesh is not None else 1
+    ni_pad = -(-n_items // (128 * n_shards)) * 128 * n_shards
     if max(n_users * ni_pad, n_items * n_items) <= DENSE_BUDGET:
         if mesh is None:
             import jax

@@ -245,8 +245,8 @@ def train_forest(X: np.ndarray, y: Sequence, params: ForestParams,
     embarrassingly-parallel axis MLlib also exploits per-tree): each
     device grows its tree subset on replicated binned data, no cross-
     device traffic until the per-tree node arrays gather at the end.
-    num_trees pads up to a device-count multiple (extra trees only
-    sharpen the vote)."""
+    num_trees pads up to a shard-count multiple for the fit, then the
+    padding is sliced off so the model is mesh-shape invariant."""
     X = np.asarray(X, np.float32)
     n, f = X.shape
     classes, codes = np.unique(np.asarray(y), return_inverse=True)
@@ -262,22 +262,31 @@ def train_forest(X: np.ndarray, y: Sequence, params: ForestParams,
     for j in range(f):
         xq[:, j] = np.searchsorted(thresholds[j], X[:, j], side="left")
 
-    t = int(params.num_trees)
-    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
-    if n_dev > 1:
-        t += (-t) % n_dev
+    t_req = int(params.num_trees)
+    # trees shard over the FIRST mesh axis only (_sharded_fit_fn), so the
+    # pad target is that axis's size, not the total device count
+    n_dev = int(mesh.shape[mesh.axis_names[0]]) if mesh is not None else 1
     depth = int(params.max_depth)
+    # RNG draws sized by the REQUESTED tree count so the stream (and hence
+    # every kept tree) is identical on any mesh; padding to the device-
+    # count multiple happens on the arrays afterwards and is sliced off
+    # the model below
     rng = np.random.default_rng(params.seed)
-    boot = rng.integers(0, n, size=(t, n)).astype(np.int32)
+    boot = rng.integers(0, n, size=(t_req, n)).astype(np.int32)
     m = _subset_size(params.feature_subset_strategy, f)
     n_nodes = 2 ** depth - 1
     if m >= f:
-        mask = np.ones((t, n_nodes, f), bool)
+        mask = np.ones((t_req, n_nodes, f), bool)
     else:
         # per-(tree, node) random feature subset of size m
-        scores = rng.random((t, n_nodes, f))
+        scores = rng.random((t_req, n_nodes, f))
         kth = np.partition(scores, m - 1, axis=-1)[..., m - 1:m]
         mask = scores <= kth
+    t = t_req + ((-t_req) % n_dev if n_dev > 1 else 0)
+    if t > t_req:
+        pad = t - t_req     # throwaway trees: re-fit copies of tree 0
+        boot = np.concatenate([boot, np.repeat(boot[:1], pad, 0)])
+        mask = np.concatenate([mask, np.repeat(mask[:1], pad, 0)])
 
     if n_dev > 1:
         fit = _sharded_fit_fn(mesh, c, depth, b, params.impurity)
@@ -289,7 +298,9 @@ def train_forest(X: np.ndarray, y: Sequence, params: ForestParams,
             jnp.asarray(xq), jnp.asarray(codes.astype(np.int32)),
             jnp.asarray(boot), jnp.asarray(mask), c, depth, b,
             params.impurity)
+    # slice the padding back off: the trained model (and its votes) must
+    # not depend on the mesh shape
     return ForestModel(
         classes=classes, thresholds=thresholds,
-        feat=np.asarray(feat), thr=np.asarray(thr),
-        leaf=np.asarray(leaf), max_depth=depth)
+        feat=np.asarray(feat)[:t_req], thr=np.asarray(thr)[:t_req],
+        leaf=np.asarray(leaf)[:t_req], max_depth=depth)

@@ -271,7 +271,7 @@ class SqliteEvents(base.EventStore):
 
         from predictionio_tpu.data.columnar import EVENT_SCHEMA
 
-        if filters.get("reversed_order") or "limit" in filters:
+        if filters.get("reversed_order") or filters.get("limit") is not None:
             ordered = True
         cols = ("id, event, entityType, entityId, targetEntityType, "
                 "targetEntityId, properties, eventTime, creationTime")

@@ -256,3 +256,48 @@ def test_forest_sharded_matches_single(mesh8):
     np.testing.assert_array_equal(m1.thr, m8.thr)
     np.testing.assert_array_equal(m1.leaf, m8.leaf)
     assert (m8.predict(X) == y).mean() > 0.85
+
+
+def _mesh42():
+    """Multi-axis mesh: shard_map paths shard over axis 0 (size 4) only;
+    regression rig for the total-vs-first-axis device-count confusion."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2),
+                axis_names=("data", "model"))
+
+
+def test_cooccurrence_multi_axis_mesh_matches_single(mesh8):
+    # engines pass mesh_of(ctx) verbatim; a runtime_conf mesh_shape "4,2"
+    # must produce the same model as a single device (r4 advisor: block
+    # geometry keyed off total device count crashed train on such meshes)
+    del mesh8  # only to ensure 8 virtual devices exist
+    from predictionio_tpu.models.cooccurrence import cooccurrence_topn
+
+    rng = np.random.default_rng(6)
+    u = rng.integers(0, 50, 600).astype(np.int32)
+    i = rng.integers(0, 37, 600).astype(np.int32)
+    du, di = distinct_pairs(u, i)
+    v1, _ = cooccurrence_topn(_mesh1(), du, di, 50, 37, 5)
+    v42, _ = cooccurrence_topn(_mesh42(), du, di, 50, 37, 5)
+    np.testing.assert_array_equal(v1, v42)
+
+
+def test_forest_padded_trees_sliced_off(mesh8):
+    # num_trees not a multiple of the shard count: the fit pads, but the
+    # MODEL must keep exactly num_trees and match the single-device run
+    # on every mesh shape (r4 advisor finding)
+    from predictionio_tpu.models.forest import ForestParams, train_forest
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(120, 4)).astype(np.float32)
+    y = np.where(X[:, 0] - X[:, 2] > 0, "hi", "lo")
+    p = ForestParams(num_trees=6, max_depth=3, max_bins=16, seed=9)
+    m1 = train_forest(X, y, p)
+    for mesh in (mesh8, _mesh42()):
+        mm = train_forest(X, y, p, mesh=mesh)
+        assert mm.feat.shape[0] == 6
+        np.testing.assert_array_equal(m1.feat, mm.feat)
+        np.testing.assert_array_equal(m1.thr, mm.thr)
+        np.testing.assert_array_equal(m1.leaf, mm.leaf)

@@ -152,6 +152,60 @@ def base_als_ml100k():
     return {"baseline_s": round(base, 3), "baseline_measured_iters": measured}
 
 
+def base_pipeline():
+    """No-jax surrogate of the judged pipeline boundary: events already
+    in a sqlite store -> read + id-assign + numpy ALS train (the `pio
+    train` wall-clock analog; import and query latency are reported
+    separately by the config, so the baseline matches its elapsed_s =
+    train-only). Store setup/import is untimed, mirroring cfg_pipeline."""
+    import tempfile
+
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.storage import App, Storage
+
+    nu, ni, nnz = 943, 1682, 100_000
+    users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=11)
+    with tempfile.TemporaryDirectory() as tmp:
+        Storage.configure({
+            "sources": {"DB": {"TYPE": "sqlite",
+                               "PATH": os.path.join(tmp, "base.db")}},
+            "repositories": {
+                "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+                "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+                "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+            },
+        })
+        from predictionio_tpu.data.eventstore import clear_cache
+        clear_cache()
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="BaseApp"))
+        store = Storage.get_events()
+        store.init_channel(app_id)
+        batch = [Event(event="rate", entity_type="user", entity_id=str(u),
+                       target_entity_type="item", target_entity_id=str(i),
+                       properties={"rating": float(r)})
+                 for u, i, r in zip(users, items, ratings)]
+        for k in range(0, len(batch), 5000):
+            store.insert_batch(batch[k:k + 5000], app_id)
+
+        t0 = time.perf_counter()
+        tbl = store.find_columnar(app_id, ordered=False)
+        eid = np.asarray(tbl.column("entity_id"))
+        tid = np.asarray(tbl.column("target_entity_id"))
+        rr = np.asarray([json.loads(p)["rating"]
+                         for p in tbl.column("properties").to_pylist()],
+                        dtype=np.float32)
+        uvocab, uidx = np.unique(eid, return_inverse=True)
+        ivocab, iidx = np.unique(tid, return_inverse=True)
+        read_s = time.perf_counter() - t0
+        base, measured = numpy_als_baseline(
+            uidx.astype(np.int32), iidx.astype(np.int32), rr,
+            len(uvocab), len(ivocab), RANK, ITERS, measure_iters=5)
+    return {"baseline_s": round(read_s + base, 3),
+            "baseline_measured_iters": measured,
+            "baseline_read_s": round(read_s, 3)}
+
+
 def base_cooccurrence():
     nu, ni, nnz = 6040, 3706, 1_000_000
     users, items, _ = synthetic_ratings(nu, ni, nnz, seed=2)
@@ -233,6 +287,7 @@ def base_als_ml20m():
 
 BASELINES = {
     "als_ml100k": base_als_ml100k,
+    "pipeline_ml100k": base_pipeline,
     "cooccurrence_ml1m": base_cooccurrence,
     "naive_bayes_spam": base_naive_bayes,
     "ecommerce_implicit_als": base_ecommerce,
@@ -565,40 +620,64 @@ def cfg_cooccurrence(jax, mesh, platform):
     from predictionio_tpu.models.cooccurrence import (
         cooccurrence_topn, distinct_pairs)
 
+    from predictionio_tpu.utils.profiling import collect_phases
+
     nu, ni, nnz = 6040, 3706, 1_000_000
     users, items, _ = synthetic_ratings(nu, ni, nnz, seed=2)
     users, items = distinct_pairs(users, items)
     n_top = 20
 
     hb("cooccurrence warmup")
-    cooccurrence_topn(mesh, users, items, nu, ni, n_top)   # compile
+    ph = {}
+    with collect_phases(ph):       # cold call: host build + upload + compile
+        t0 = time.perf_counter()
+        cooccurrence_topn(mesh, users, items, nu, ni, n_top)
+        cold = time.perf_counter() - t0
     hb("cooccurrence timed")
     t0 = time.perf_counter()
     scores, idx = cooccurrence_topn(mesh, users, items, nu, ni, n_top)
     elapsed = time.perf_counter() - t0
     # matmul-dominated: A^T A is 2 * nu * ni^2 flops
     flops = 2.0 * nu * ni * ni
+    build_s = ph.get("incidence_build", 0.0)
+    transfer_s = ph.get("incidence_transfer", 0.0)
     return {"elapsed_s": round(elapsed, 4),
+            "build_s": round(build_s, 3),
+            "transfer_s": round(transfer_s, 3),
+            "compile_s": round(cold - elapsed - build_s - transfer_s, 3),
             "model_flops": flops,
-            "note": f"{len(users)} distinct pairs"}
+            "note": f"{len(users)} distinct pairs; steady-state counts on "
+                    f"a resident incidence matrix (cold build+upload+compile "
+                    f"reported separately)"}
 
 
 def cfg_naive_bayes(jax, mesh, platform):
     """Config 3: classification NaiveBayes, spam/ham-scale."""
     from predictionio_tpu.models.naive_bayes import train_multinomial_nb
 
+    from predictionio_tpu.utils.profiling import collect_phases
+
     X, labels = _nb_data()
     hb("naive_bayes warmup")
-    model = train_multinomial_nb(X, labels, mesh=mesh)     # warm-up
+    ph = {}
+    with collect_phases(ph):       # cold call: compact + upload + compile
+        model = train_multinomial_nb(X, labels, mesh=mesh)
+        model.predict(X)           # compile the score matmul too
     hb("naive_bayes timed")
     t0 = time.perf_counter()
     model = train_multinomial_nb(X, labels, mesh=mesh)
+    t1 = time.perf_counter()
     pred = model.predict(X)
     elapsed = time.perf_counter() - t0
     acc = float((pred == labels).mean())
     assert acc > 0.9, f"NB accuracy {acc}"
     return {"elapsed_s": round(elapsed, 4),
-            "note": f"accuracy {acc:.3f}"}
+            "train_s": round(t1 - t0, 4),
+            "predict_s": round(elapsed - (t1 - t0), 4),
+            "compact_s": round(ph.get("nb_compact", 0.0), 3),
+            "transfer_s": round(ph.get("nb_transfer", 0.0), 3),
+            "note": f"accuracy {acc:.3f}; steady-state train+predict on a "
+                    f"resident X (cold compact+upload reported separately)"}
 
 
 def cfg_ecommerce(jax, mesh, platform):
@@ -913,9 +992,13 @@ class Suite:
         # never clobber — or MIX METADATA INTO — a baseline the worker
         # measured itself (the scaled CPU ml20m run carries its own
         # matched baseline; the external entry describes a different
-        # workload shape)
-        if "baseline_s" in detail:
+        # workload shape). Value check, not key presence: a config that
+        # reports baseline_s=None is declaring "none of my own", not
+        # vetoing the externally measured one
+        if detail.get("baseline_s") is not None:
             base = {}
+        else:
+            detail.pop("baseline_s", None)
         detail.update({k: v for k, v in base.items()
                        if k != "name" and k not in detail})
         b, e = detail.get("baseline_s"), detail.get("elapsed_s")

@@ -114,6 +114,38 @@ def _build_rows(seg_local: np.ndarray, tgt: np.ndarray, val: np.ndarray,
     return tgt_out, val_out, w_out, row_seg
 
 
+def _bucket_rows(r_max: int) -> int:
+    """Bucket the padded row count so near-identical datasets (k-fold
+    splits of one rating set differ by ~1/k rows) share ONE compiled
+    program — without this an eval sweep pays folds x ranks separate XLA
+    compiles, minutes on a TPU; padding rows carry w=0 and fold into the
+    padding segment, so the math is unchanged. Single definition: the
+    single-process and distributed builders MUST round identically or
+    their programs stop sharing the jit cache."""
+    return max(256, -(-r_max // 256) * 256)
+
+
+def _stack_parts(per_shard, r_max: int, row_len: int, seg_per_shard: int):
+    """Stack per-shard `_build_rows` outputs into the padded [S, R, L]
+    (+[S, R] seg) arrays — shared by shard_rows and build_distributed."""
+    n = len(per_shard)
+
+    def _stack(idx, fill, dtype, shape_tail):
+        out = np.full((n, r_max) + shape_tail, fill, dtype=dtype)
+        for s, parts in enumerate(per_shard):
+            a = parts[idx]
+            out[s, :a.shape[0]] = a
+        return out
+
+    seg_out = np.full((n, r_max), seg_per_shard - 1, np.int32)
+    for s, (_, _, _, rs) in enumerate(per_shard):
+        seg_out[s, :rs.shape[0]] = rs
+    return (_stack(0, 0, np.int32, (row_len,)),
+            _stack(1, 0.0, np.float32, (row_len,)),
+            _stack(2, 0.0, np.float32, (row_len,)),
+            seg_out)
+
+
 def shard_rows(seg_idx: np.ndarray, tgt_idx: np.ndarray, values: np.ndarray,
                n_segments: int, n_shards: int,
                weights: Optional[np.ndarray] = None,
@@ -139,29 +171,10 @@ def shard_rows(seg_idx: np.ndarray, tgt_idx: np.ndarray, values: np.ndarray,
         per_shard.append(_build_rows(
             seg_s[lo:hi] - s * seg_per_shard, tgt_s[lo:hi], val_s[lo:hi],
             w_s[lo:hi] if w_s is not None else None, row_len, seg_per_shard))
-    r_max = max(t.shape[0] for t, _, _, _ in per_shard)
-    # bucket the row count so near-identical datasets (k-fold splits of
-    # one rating set differ by ~1/k rows) share ONE compiled program —
-    # without this an eval sweep pays folds x ranks separate XLA
-    # compiles, minutes on a TPU; padding rows carry w=0 and fold into
-    # the padding segment, so the math is unchanged
-    r_max = max(256, -(-r_max // 256) * 256)
-
-    def _stack(idx, fill, dtype, shape_tail):
-        out = np.full((n_shards, r_max) + shape_tail, fill, dtype=dtype)
-        for s, parts in enumerate(per_shard):
-            a = parts[idx]
-            out[s, :a.shape[0]] = a
-        return out
-
-    seg_out = np.full((n_shards, r_max), seg_per_shard - 1, np.int32)
-    for s, (_, _, _, rs) in enumerate(per_shard):
-        seg_out[s, :rs.shape[0]] = rs
+    r_max = _bucket_rows(max(t.shape[0] for t, _, _, _ in per_shard))
+    tgt, val, w, seg = _stack_parts(per_shard, r_max, row_len, seg_per_shard)
     return ShardedRows(
-        tgt=_stack(0, 0, np.int32, (row_len,)),
-        val=_stack(1, 0.0, np.float32, (row_len,)),
-        w=_stack(2, 0.0, np.float32, (row_len,)),
-        seg=seg_out,
+        tgt=tgt, val=val, w=w, seg=seg,
         seg_per_shard=seg_per_shard,
         n_segments=n_shards * seg_per_shard,
         row_len=row_len,
@@ -223,14 +236,7 @@ class ALSData:
                 f"data built for {n_rows} shards but mesh has "
                 f"{mesh.devices.size} devices — build with "
                 "n_shards=mesh.devices.size for multi-process put()")
-            me = jax.process_index()
-            rows_mine = [i for i, d in enumerate(mesh.devices.flat)
-                         if d.process_index == me]
-            lo, hi = min(rows_mine), max(rows_mine) + 1
-            assert len(rows_mine) == hi - lo, (
-                "mesh interleaves processes along the shard axis "
-                f"(process {me} owns rows {rows_mine}); multi-process "
-                "put() requires process-contiguous device order")
+            lo, hi = _process_shard_range(mesh)
 
         def commit_one(arr, sharding):
             if isinstance(arr, jax.Array):
@@ -426,22 +432,173 @@ def _cached_train_fn(mesh: Mesh, data_dims, params: ALSParams,
     return fn
 
 
+def _process_shard_range(mesh: Mesh) -> Tuple[int, int]:
+    """This process's contiguous run [lo, hi) of mesh shard rows (one row
+    per device along the flattened mesh). Asserts the layout every
+    multi-process path requires: process-contiguous device order."""
+    import jax
+
+    me = jax.process_index()
+    rows_mine = [i for i, d in enumerate(mesh.devices.flat)
+                 if d.process_index == me]
+    lo, hi = min(rows_mine), max(rows_mine) + 1
+    assert len(rows_mine) == hi - lo, (
+        "mesh interleaves processes along the shard axis "
+        f"(process {me} owns rows {rows_mine}); multi-process data "
+        "layouts require process-contiguous device order")
+    return lo, hi
+
+
+def build_distributed(mesh: Mesh, user_idx: np.ndarray,
+                      item_idx: np.ndarray, ratings: np.ndarray,
+                      n_users: int, n_items: int,
+                      row_len: Optional[int] = None) -> ALSData:
+    """Assemble mesh-committed ALSData from PER-PROCESS event shards.
+
+    The full partitioned input pipeline (SURVEY §2.9 P2 + P4): each
+    process passes only the ratings its own storage shard produced
+    (`find_columnar(shard=(p, P))`, the JDBCPEvents.scala:89-101
+    partition-read analog), rows are re-keyed to their segment owners by
+    ONE `lax.all_to_all` per side (parallel/shuffle.py — the Spark
+    shuffle as an XLA collective), and each process packs + commits only
+    its own padded row blocks. No process ever materializes the global
+    rating set; peak host memory is the local shard + its exchange bins.
+
+    Single-process meshes degrade to `ALSData.build(...).put(mesh)`.
+    """
+    import jax
+
+    from predictionio_tpu.parallel.shuffle import allgather_object, \
+        exchange_rows
+
+    user_idx = np.ascontiguousarray(user_idx, np.int32)
+    item_idx = np.ascontiguousarray(item_idx, np.int32)
+    ratings = np.ascontiguousarray(ratings, np.float32)
+    n_shards = int(mesh.devices.size)
+    if jax.process_count() == 1:
+        return ALSData.build(user_idx, item_idx, ratings, n_users,
+                             n_items, n_shards, row_len=row_len).put(mesh)
+
+    lo, hi = _process_shard_range(mesh)
+    shards_per_proc = hi - lo
+    # global sizes ride one tiny metadata all-gather
+    meta = allgather_object({
+        "nnz": int(len(ratings)),
+        "hash": _coo_hash_commutative(user_idx, item_idx, ratings)})
+    nnz = sum(m["nnz"] for m in meta)
+    digest = _combine_coo_hashes(meta, nnz)
+    if row_len is None:
+        row_len = _auto_row_len(nnz, max(n_users, n_items))
+
+    payload = np.stack([user_idx, item_idx,
+                        ratings.view(np.int32)], axis=1)
+
+    # each shard row's owner read off the mesh itself — never inferred
+    # from arithmetic, which would silently drop rows on meshes with
+    # uneven devices-per-process or non-ascending process order
+    proc_of_shard = np.asarray(
+        [d.process_index for d in mesh.devices.flat], np.int32)
+
+    def one_side(n_segments: int, seg_col: int, tgt_col: int):
+        seg_per_shard = -(-max(n_segments, 1) // n_shards)
+        shard_of = np.minimum(payload[:, seg_col] // seg_per_shard,
+                              n_shards - 1)
+        mine = exchange_rows(proc_of_shard[shard_of], payload)
+        seg = mine[:, seg_col]
+        assert seg.size == 0 or (
+            seg.min() >= lo * seg_per_shard
+            and seg.max() < hi * seg_per_shard), (
+            "exchange delivered segments outside this process's shard "
+            "range — shard ownership mapping is inconsistent")
+        order = np.argsort(seg, kind="stable")
+        seg_s = seg[order].astype(np.int64)
+        tgt_s = mine[order, tgt_col]
+        val_s = mine[order, 2].view(np.float32)
+        # pack each OWNED shard's rows (the local slice of shard_rows,
+        # with the row-count bucketing agreed globally via all-gather)
+        bounds = np.searchsorted(
+            seg_s, (lo + np.arange(shards_per_proc + 1)) * seg_per_shard)
+        parts = []
+        for j in range(shards_per_proc):
+            a, b = int(bounds[j]), int(bounds[j + 1])
+            parts.append(_build_rows(
+                seg_s[a:b] - (lo + j) * seg_per_shard, tgt_s[a:b],
+                val_s[a:b], None, row_len, seg_per_shard))
+        r_local = max(t.shape[0] for t, _, _, _ in parts)
+        r_max = _bucket_rows(max(allgather_object(r_local)))
+        tgt, val, w, seg = _stack_parts(parts, r_max, row_len,
+                                        seg_per_shard)
+
+        def commit(local, tail):
+            # specs spelled exactly as ALSData.put writes them, so put()'s
+            # idempotence check recognizes these arrays as already resident
+            spec = P("data", None, None) if tail else P("data", None)
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), np.ascontiguousarray(local),
+                (n_shards, r_max) + tail)
+
+        return ShardedRows(
+            tgt=commit(tgt, (row_len,)),
+            val=commit(val, (row_len,)),
+            w=commit(w, (row_len,)),
+            seg=commit(seg, ()),
+            seg_per_shard=seg_per_shard,
+            n_segments=n_shards * seg_per_shard,
+            row_len=row_len)
+
+    by_user = one_side(n_users, 0, 1)
+    by_item = one_side(n_items, 1, 0)
+    out = ALSData(by_user=by_user, by_item=by_item,
+                  n_users=n_users, n_items=n_items,
+                  n_users_pad=by_user.n_segments,
+                  n_items_pad=by_item.n_segments,
+                  nnz=nnz, digest=digest)
+    jax.block_until_ready([
+        out.by_user.tgt, out.by_user.val, out.by_user.w, out.by_user.seg,
+        out.by_item.tgt, out.by_item.val, out.by_item.w, out.by_item.seg])
+    return out
+
+
+def _coo_hash_commutative(user_idx, item_idx, ratings) -> int:
+    """Per-process contribution to an order- AND partition-independent
+    dataset hash: a commutative sum of per-row mixes (splitmix64-style),
+    so the combined digest is identical however rows are spread across
+    processes. Weaker than blake2b over sorted rows but still sensitive
+    to any single changed rating — enough for checkpoint fingerprints."""
+    with np.errstate(over="ignore"):
+        h = (user_idx.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+             ^ item_idx.astype(np.uint64) * np.uint64(0xC2B2AE3D27D4EB4F)
+             ^ ratings.view(np.uint32).astype(np.uint64)
+             * np.uint64(0x165667B19E3779F9))
+        h ^= h >> np.uint64(31)
+        h *= np.uint64(0xFF51AFD7ED558CCD)
+        h ^= h >> np.uint64(29)
+        return int(h.sum(dtype=np.uint64))
+
+
+def _combine_coo_hashes(meta, nnz: int) -> str:
+    total = np.uint64(0)
+    with np.errstate(over="ignore"):
+        for m in meta:
+            total += np.uint64(m["hash"])
+    return f"coo-{nnz}-{int(total):016x}"
+
+
 def coo_digest(user_idx: np.ndarray, item_idx: np.ndarray,
                ratings: np.ndarray) -> str:
-    """Identity hash of the FULL rating set (canonical dtypes, so int32 vs
-    int64 inputs digest identically). Full, not sampled: a checkpoint
-    resumed against data where even one rating changed must retrain, and
-    blake2b at a few hundred MB/s is noise next to the argsorts
-    ALSData.build already does over the same arrays."""
-    import hashlib
+    """Identity hash of the FULL rating set (canonical dtypes, so int32
+    vs int64 inputs digest identically). Full, not sampled: a checkpoint
+    resumed against data where even one rating changed must retrain.
 
-    h = hashlib.blake2b(digest_size=16)
-    h.update(np.asarray([len(ratings)], np.int64).tobytes())
-    for arr, dt in ((user_idx, np.int64), (item_idx, np.int64),
-                    (ratings, np.float32)):
-        h.update(np.ascontiguousarray(
-            np.asarray(arr).reshape(-1).astype(dt)).tobytes())
-    return h.hexdigest()
+    The hash is a commutative sum of per-row mixes, so it is independent
+    of row ORDER and of how rows are PARTITIONED across processes —
+    single-process `ALSData.build` and multi-host `build_distributed`
+    digest the same data identically, which the als_fingerprint
+    mesh-shape-independence contract requires."""
+    u = np.ascontiguousarray(np.asarray(user_idx).reshape(-1), np.int64)
+    i = np.ascontiguousarray(np.asarray(item_idx).reshape(-1), np.int64)
+    r = np.ascontiguousarray(np.asarray(ratings).reshape(-1), np.float32)
+    return f"coo-{len(r)}-{_coo_hash_commutative(u, i, r):016x}"
 
 
 def als_fingerprint(data: ALSData, params: ALSParams) -> str:

@@ -28,6 +28,7 @@ without the shuffle).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -83,14 +84,31 @@ def cooccurrence_topn(mesh, user_idx: np.ndarray, item_idx: np.ndarray,
     blk = -(-n_items // (128 * n_shards)) * 128
     ni_pad = blk * n_shards
 
-    a = np.zeros((n_users, ni_pad), np.float32)
-    a[user_idx, item_idx] = 1.0
-    if jax.default_backend() in ("tpu", "axon"):
-        a = a.astype(jnp.bfloat16)      # exact for 0/1; halves the upload;
-        # f32 elsewhere: CPU XLA emulates bf16 matmuls slowly
+    def _put_incidence():
+        from predictionio_tpu.utils.profiling import phase
 
+        # build uint8 on host (quarter the f32 bytes over the host->device
+        # link) — the kernel widens to the compute dtype on device, where
+        # the cast fuses into the matmul read for free
+        with phase("incidence_build"):
+            a = np.zeros((n_users, ni_pad), np.uint8)
+            a[user_idx, item_idx] = 1
+        with phase("incidence_transfer"):
+            a_dev = jax.device_put(a, NamedSharding(mesh, P(None, axis)))
+            jax.block_until_ready(a_dev)
+        return a_dev
+
+    # resident across calls keyed on the pair arrays: eval sweeps and
+    # warm/timed reruns over the same interactions upload A once
+    # (ops/device_cache — the ALSData.put rule for ad-hoc inputs)
+    from predictionio_tpu.ops import device_cache
+
+    # the hashable Mesh itself keys the layout — id(mesh) could alias
+    # after the mesh is GC'd (the fn_cache.py rule)
+    a_dev = device_cache.resident(
+        [user_idx, item_idx],
+        ("cooc_a", mesh, axis, n_users, ni_pad), _put_incidence)
     run = _sharded_topn_fn(mesh, axis, n_shards, blk, ni_pad, k)
-    a_dev = jax.device_put(a, NamedSharding(mesh, P(None, axis)))
     vals, idx = jax.device_get(run(a_dev))
     return np.asarray(vals)[:n_items], np.asarray(idx)[:n_items]
 
@@ -107,9 +125,21 @@ def _sharded_topn_fn(mesh, axis: str, n_dev: int, blk: int, ni_pad: int,
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        def block(a_cols, a_full):
-            # a_cols [nu, blk]: this device's item block; a_full replicated
-            c = jnp.dot(a_cols.T, a_full,
+        from jax.sharding import NamedSharding
+
+        # uint8 A widens on device: bf16 on the MXU (0/1 exact, f32
+        # accumulate), f32 on CPU where XLA emulates bf16 matmuls slowly
+        cdt = (jnp.bfloat16 if jax.default_backend() in ("tpu", "axon")
+               else jnp.float32)
+
+        def block(a_cols):
+            # a_cols [nu, blk]: this device's item column block; the full
+            # width is assembled on-device by ONE all-gather riding
+            # ICI/DCN — no host ever feeds a replicated copy, which also
+            # makes the same kernel serve multi-process meshes
+            a_full = jax.lax.all_gather(
+                a_cols.astype(cdt), axis, axis=1, tiled=True)
+            c = jnp.dot(a_cols.T.astype(cdt), a_full,
                         preferred_element_type=jnp.float32)  # [blk, ni_pad]
             row0 = jax.lax.axis_index(axis) * blk
             rows = row0 + jnp.arange(blk)[:, None]
@@ -120,19 +150,79 @@ def _sharded_topn_fn(mesh, axis: str, n_dev: int, blk: int, ni_pad: int,
 
         sharded = shard_map(
             block, mesh=mesh,
-            in_specs=(P(None, axis), P()),
+            in_specs=P(None, axis),
             out_specs=(P(axis, None, None), P(axis, None, None)),
             check_vma=False)
 
-        @jax.jit
+        # replicated output: every process can device_get the full top-N
+        # (multi-host safe); on one process the final gather is free
+        @functools.partial(
+            jax.jit, out_shardings=NamedSharding(mesh, P()))
         def run(a_dev):
-            vals, idx = sharded(a_dev, a_dev)
+            vals, idx = sharded(a_dev)
             return (vals.reshape(ni_pad, k), idx.reshape(ni_pad, k))
 
         return run
 
     return mesh_cached_fn("cooccurrence_topn", mesh,
                           (axis, blk, ni_pad, k), build)
+
+
+def cooccurrence_topn_distributed(mesh, local_user_idx: np.ndarray,
+                                  local_item_idx: np.ndarray,
+                                  n_users: int, n_items: int, n_top: int
+                                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Multi-process top-N cooccurrence from PER-PROCESS event shards.
+
+    Each process passes only the (user, item) pairs its own storage shard
+    produced (`find_columnar(shard=...)`); pairs are re-keyed to their
+    item-column-block owners by one `lax.all_to_all`
+    (parallel/shuffle.py), de-duplicated locally, and each process builds
+    + commits only ITS column block of the incidence matrix. The same
+    sharded matmul kernel then runs with the full-width gather riding the
+    interconnect. No process ever materializes the global pair set or the
+    full incidence matrix — the Spark distinct+self-join as collectives.
+    """
+    import jax
+    import jax.numpy as jnp  # noqa: F401  (backend probe inside kernel)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.models.als import _process_shard_range
+    from predictionio_tpu.parallel.shuffle import exchange_rows
+
+    axis = mesh.axis_names[0]
+    n_shards = int(mesh.shape[axis])
+    assert n_shards == int(np.prod(mesh.devices.shape)), (
+        "distributed cooccurrence requires a 1-axis mesh")
+    k = int(min(n_top, n_items))
+    blk = -(-n_items // (128 * n_shards)) * 128
+    ni_pad = blk * n_shards
+
+    lo, hi = _process_shard_range(mesh)
+    shards_per_proc = hi - lo
+    # owner read off the mesh (not arithmetic — uneven devices-per-
+    # process or non-ascending process order would mis-route rows)
+    proc_of_shard = np.asarray(
+        [d.process_index for d in mesh.devices.flat], np.int32)
+    dest = proc_of_shard[np.minimum(
+        local_item_idx.astype(np.int64) // blk, n_shards - 1)]
+    payload = np.stack([np.ascontiguousarray(local_user_idx, np.int32),
+                        np.ascontiguousarray(local_item_idx, np.int32)],
+                       axis=1)
+    mine = exchange_rows(dest, payload)
+    # global dedup is now local: every copy of a pair landed here
+    u, i = distinct_pairs(mine[:, 0], mine[:, 1]) if len(mine) else (
+        mine[:, 0], mine[:, 1])
+    assert i.size == 0 or (i.min() >= lo * blk and i.max() < hi * blk), (
+        "exchange delivered items outside this process's column range")
+
+    a_local = np.zeros((n_users, shards_per_proc * blk), np.uint8)
+    a_local[u, i - lo * blk] = 1
+    a_dev = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(None, axis)), a_local, (n_users, ni_pad))
+    run = _sharded_topn_fn(mesh, axis, n_shards, blk, ni_pad, k)
+    vals, idx = jax.device_get(run(a_dev))
+    return np.asarray(vals)[:n_items], np.asarray(idx)[:n_items]
 
 
 def cooccurrence_topn_host(user_idx: np.ndarray, item_idx: np.ndarray,

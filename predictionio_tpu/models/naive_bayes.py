@@ -101,8 +101,9 @@ def train_categorical_nb(points: Sequence[LabeledPoint]
 # Multinomial NB (MLlib analog)
 # ---------------------------------------------------------------------------
 
-#: inputs below this element count train on host (np.add.at) — the device
-#: (or sharded-device) count matmul can't repay its transfer + dispatch
+#: inputs below this element count train on host (BLAS one-hot gemm) —
+#: the device (or sharded-device) count matmul can't repay its transfer
+#: + dispatch below this size
 DEVICE_MIN_SIZE = 1_000_000
 
 def _sharded_count_fn(mesh, axis: str, n_labels: int):
@@ -128,6 +129,26 @@ def _sharded_count_fn(mesh, axis: str, n_labels: int):
     return mesh_cached_fn("nb_count", mesh, (axis, n_labels), build)
 
 
+_count_fns: Dict[int, Callable] = {}
+
+
+def _count_fn(n_labels: int):
+    """Stable single-device count jit per label count (a per-call jit
+    would recompile every train — seconds over a remote-compile relay)."""
+    fn = _count_fns.get(n_labels)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def count(codes, x):
+            onehot = jax.nn.one_hot(codes, n_labels, dtype=jnp.float32)
+            return onehot.T @ x.astype(jnp.float32)
+
+        fn = _count_fns[n_labels] = count
+    return fn
+
+
 def _compact_for_transfer(X: np.ndarray) -> np.ndarray:
     """Count matrices are usually small non-negative integers stored as
     float; ship them as uint8/uint16 (4x/2x fewer bytes over the
@@ -143,6 +164,30 @@ def _compact_for_transfer(X: np.ndarray) -> np.ndarray:
     return X.astype(np.uint8 if xmax < 256 else np.uint16)
 
 
+_score_jit = None      # stable jit: per-call wrappers would re-trace
+                       # (and re-COMPILE — seconds per call over a
+                       # remote-compile relay) on every predict
+
+
+def _score_fn():
+    global _score_jit
+    if _score_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score(x, lp, pri):
+            return x.astype(jnp.float32) @ lp.T + pri[None, :]
+
+        _score_jit = score
+    return _score_jit
+
+
+#: device predict only pays off above this element count when the input
+#: is NOT already device-resident (host BLAS beats tunnel transfer)
+PREDICT_DEVICE_MIN_SIZE = 50_000_000
+
+
 @dataclasses.dataclass
 class MultinomialNBModel:
     """label vocab + log priors [L] + log feature probs [L, F]."""
@@ -152,17 +197,31 @@ class MultinomialNBModel:
     log_prob: np.ndarray
 
     def predict_scores(self, X: np.ndarray) -> np.ndarray:
-        """[N, F] -> [N, L] joint log-likelihood (one MXU matmul)."""
+        """[N, F] -> [N, L] joint log-likelihood (one matmul).
+
+        Dispatch-aware routing (the serving-path rule, models/als.py
+        _use_host): the matmul is tiny next to shipping X over the
+        host->device link, so the device only wins when X is already
+        resident there (train just ran on it) or very large. The cache
+        keys on the CALLER's array object (atleast_2d happens inside the
+        build), so train-then-predict on the same X reuses one upload."""
+        from predictionio_tpu.ops import device_cache
+
+        if not device_cache.is_resident([X], ("nb_x",)) \
+                and X.size < PREDICT_DEVICE_MIN_SIZE:
+            xs = np.atleast_2d(X)
+            return xs.astype(np.float32, copy=False) @ self.log_prob.T \
+                + self.log_prior[None, :]
         import jax
-        import jax.numpy as jnp
 
-        @jax.jit
-        def score(x, lp, pri):
-            return x.astype(jnp.float32) @ lp.T + pri[None, :]
-
-        return np.asarray(jax.device_get(score(
-            jnp.asarray(_compact_for_transfer(X)),
-            jnp.asarray(self.log_prob), jnp.asarray(self.log_prior))))
+        xd = device_cache.resident(
+            [X], ("nb_x",),
+            lambda: jax.device_put(_compact_for_transfer(np.atleast_2d(X))))
+        scores = np.asarray(jax.device_get(_score_fn()(
+            xd, self.log_prob, self.log_prior)))
+        # a resident copy from a sharded train carries device-count
+        # padding rows; slice back to the caller's row count
+        return scores[:np.atleast_2d(X).shape[0]]
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         scores = self.predict_scores(np.atleast_2d(X))
@@ -180,6 +239,8 @@ def train_multinomial_nb(X: np.ndarray, labels: Sequence[str],
     each device contributes a partial [L, F] count combined by one psum —
     the collective analog of the reference's distributed `combineByKey`
     (e2/.../CategoricalNaiveBayes.scala:29, SURVEY §2.9 P1)."""
+    from predictionio_tpu.ops import device_cache
+
     labels = np.asarray(labels, dtype=object)
     label_vocab, label_codes = np.unique(labels, return_inverse=True)
     n_labels = len(label_vocab)
@@ -191,31 +252,66 @@ def train_multinomial_nb(X: np.ndarray, labels: Sequence[str],
     if mesh is not None and n_dev > 1 and X.size >= DEVICE_MIN_SIZE \
             and X.shape[0] * n_labels * 4 <= (1 << 28) * n_dev:
         import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         axis = mesh.axis_names[0]
-        pad = (-len(label_codes)) % n_dev
+        shard = int(mesh.shape[axis])
+        pad = (-len(label_codes)) % shard
+
+        def _put_x_sharded():
+            from predictionio_tpu.utils.profiling import phase
+
+            with phase("nb_compact"):
+                Xc = _compact_for_transfer(X)
+                if pad:
+                    Xc = np.concatenate(
+                        [Xc, np.zeros((pad, n_features), Xc.dtype)])
+            with phase("nb_transfer"):
+                xd = jax.device_put(Xc, NamedSharding(mesh, P(axis, None)))
+                jax.block_until_ready(xd)
+            return xd
+
+        # only X's sharded placement is cached (labels change freely —
+        # the tiny padded codes vector ships fresh on every call); the
+        # hashable Mesh itself keys the layout (id(mesh) could alias
+        # after GC — the fn_cache.py rule)
+        xd = device_cache.resident(
+            [X], ("nb_x_sharded", mesh, pad), _put_x_sharded)
+        # alias under predict's key too: model.predict(X) must reuse this
+        # resident copy instead of paying a second full upload (the score
+        # matmul slices the padding rows back off)
+        device_cache.resident([X], ("nb_x",), lambda: xd)
         codes = np.concatenate(
             [label_codes.astype(np.int32),
              np.full(pad, -1, np.int32)]         # one_hot(-1) == zero row
         ) if pad else label_codes.astype(np.int32)
-        Xc = _compact_for_transfer(X)
-        Xp = np.concatenate(
-            [Xc, np.zeros((pad, n_features), Xc.dtype)]) if pad else Xc
         counts = np.asarray(jax.device_get(
-            _sharded_count_fn(mesh, axis, n_labels)(codes, Xp)
+            _sharded_count_fn(mesh, axis, n_labels)(codes, xd)
         )).astype(np.float64)
     elif X.size >= DEVICE_MIN_SIZE and X.shape[0] * n_labels * 4 <= 1 << 28:
         import jax
-        import jax.numpy as jnp
 
-        @jax.jit
-        def count(codes, x):
-            onehot = jax.nn.one_hot(codes, n_labels, dtype=jnp.float32)
-            return onehot.T @ x.astype(jnp.float32)
+        def _put_x():
+            from predictionio_tpu.utils.profiling import phase
 
-        counts = np.asarray(jax.device_get(count(
-            jnp.asarray(label_codes),
-            jnp.asarray(_compact_for_transfer(X))))).astype(np.float64)
+            with phase("nb_compact"):
+                Xc = _compact_for_transfer(X)
+            with phase("nb_transfer"):
+                xd = jax.device_put(Xc)
+                jax.block_until_ready(xd)
+            return xd
+
+        xd = device_cache.resident([X], ("nb_x",), _put_x)
+        counts = np.asarray(jax.device_get(_count_fn(n_labels)(
+            label_codes.astype(np.int32), xd))).astype(np.float64)
+    elif X.dtype.kind == "f" and X.shape[0] * n_labels * 4 <= 1 << 28:
+        # host BLAS one-hot count: one [L, N] @ [N, F] gemm — ~20x faster
+        # than np.add.at's per-element scatter at spam-corpus sizes. Same
+        # 256MB one-hot bound as the device branch: past it, fall through
+        # to the O(1)-extra-memory scatter fold
+        onehot = np.zeros((n_labels, X.shape[0]), np.float32)
+        onehot[label_codes, np.arange(X.shape[0])] = 1.0
+        counts = (onehot @ X).astype(np.float64)
     else:
         counts = np.zeros((n_labels, n_features), np.float64)
         np.add.at(counts, label_codes, X)

@@ -374,5 +374,12 @@ def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
                                      "opt_leaves": jax.tree.leaves(opt_state)},
                               fingerprint=fp)
     del opt_state
+    if mesh is not None and jax.process_count() > 1:
+        # tp-sharded leaves span processes (not host-addressable); one
+        # jitted identity with replicated out_shardings gathers them over
+        # the interconnect so every host can extract the full model
+        replicate = jax.jit(
+            lambda t: t, out_shardings=NamedSharding(mesh, P()))
+        params = replicate(params)
     host = jax.tree.map(np.asarray, params)
     return SeqRecModel(item_vocab=all_items, params=host, hyper=p)

@@ -1,0 +1,88 @@
+"""Device-resident input cache.
+
+The framework's steady-state rule is "commit host data to the mesh once,
+then let every step consume resident arrays" (models/als.py ALSData.put).
+This module extends that rule to ad-hoc inputs (classifier matrices,
+incidence matrices): `resident()` keys a device array on the IDENTITY of
+the host arrays it was built from, so back-to-back train/predict calls
+over the same host data transfer it once.
+
+Why identity and not content: hashing 100MB+ inputs would cost as much
+as the transfer it avoids. Identity keying assumes callers do not mutate
+training arrays in place between calls — the same contract jit's
+donate_argnums and ALSData already rely on. Entries evict automatically
+when any source array is garbage-collected (weakref finalizers), so the
+cache cannot outlive the host data and cannot grow past the number of
+live distinct inputs.
+
+This matters doubly over a tunneled chip (the axon relay): a host->device
+transfer issued after an executable launch pays a pipeline-flush stall
+measured in hundreds of ms, so avoiding the re-upload also avoids the
+stall (measured r5: NB train went 1.6s -> ~70ms on cache hits).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import numpy as np
+
+_lock = threading.Lock()
+_cache: Dict[Tuple, Any] = {}
+
+
+def _key_of(arrays: Sequence[np.ndarray], extra: Tuple) -> Tuple:
+    return tuple((id(a), a.shape, str(a.dtype)) for a in arrays) + (extra,)
+
+
+def is_resident(arrays: Sequence[np.ndarray], extra: Tuple) -> bool:
+    """True when `resident(arrays, extra, ...)` would hit the cache —
+    the public residency probe for dispatch-aware routing (callers must
+    not poke the key/lock internals)."""
+    with _lock:
+        return _key_of(arrays, extra) in _cache
+
+
+def resident(arrays: Sequence[np.ndarray], extra: Tuple,
+             build: Callable[[], Any]) -> Any:
+    """Return `build()`'s result, cached until any of `arrays` is GC'd.
+
+    `arrays` are the host ndarrays the device value derives from (the
+    cache key + lifetime anchors). `extra` distinguishes different device
+    layouts of the same data (mesh id, sharding spec, dtype, padding).
+    """
+    key = _key_of(arrays, extra)
+    with _lock:
+        hit = _cache.get(key)
+    if hit is not None:
+        return hit[0]
+    val = build()
+    # weakref.ref with a callback (not finalize): eviction must not keep
+    # the source arrays alive, and np arrays support weakrefs
+    refs = []
+    for a in arrays:
+        try:
+            refs.append(weakref.ref(a, lambda _r, k=key: _evict(k)))
+        except TypeError:        # non-weakref-able (e.g. scalar) — skip
+            pass
+    with _lock:
+        _cache[key] = (val, refs)
+    return val
+
+
+def _evict(key: Tuple) -> None:
+    with _lock:
+        _cache.pop(key, None)
+
+
+def clear() -> None:
+    """Drop every cached device buffer (tests; post-train teardown)."""
+    with _lock:
+        _cache.clear()
+
+
+def size() -> int:
+    with _lock:
+        return len(_cache)

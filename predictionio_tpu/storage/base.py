@@ -373,9 +373,18 @@ class EventStore(abc.ABC):
         math is permutation-invariant — the JdbcRDD-partition contract)
         accepts ARBITRARY row order; backends may then skip the time sort.
         The default keeps the row path's chronological guarantee (exports,
-        dumps). Default implementation materializes through `find`;
-        columnar backends override with a direct scan.
+        dumps). ``shard=(index, count)`` restricts the scan to one of
+        `count` disjoint row partitions (the multi-host partitioned
+        training read); backends that cannot partition must refuse rather
+        than silently hand every process the full set. Default
+        implementation materializes through `find`; columnar backends
+        override with a direct scan.
         """
+        if filters.get("shard") is not None:
+            raise StorageError(
+                f"{type(self).__name__} does not support sharded "
+                "(partitioned) reads")
+        filters.pop("shard", None)
         from predictionio_tpu.data.columnar import events_to_table
         return events_to_table(self.find(app_id, channel_id, **filters))
 

@@ -169,8 +169,13 @@ class ParquetEvents(base.EventStore):
         with self.client.fs.open(path, "wb") as f:
             pq.write_table(table, f)
 
-    def _read_all(self, ns: str) -> pa.Table:
+    def _read_all(self, ns: str, shard=None) -> pa.Table:
         frags = self._fragments(ns)
+        if shard is not None:
+            idx, count = shard
+            if not (0 <= idx < count):
+                raise StorageError(f"bad shard {shard}")
+            frags = frags[idx::count]
         if not frags:
             return STORE_SCHEMA.empty_table()
         tables = []
@@ -232,12 +237,20 @@ class ParquetEvents(base.EventStore):
         target_entity_id=UNFILTERED,
         limit: Optional[int] = None,
         reversed_order: bool = False,
+        shard: Optional[tuple] = None,
     ) -> pa.Table:
-        """Vectorized filter over all fragments — the training hot path."""
+        """Vectorized filter over all fragments — the training hot path.
+
+        ``shard=(index, count)`` assigns whole FRAGMENTS round-robin to
+        one of `count` readers (the partitioned training read, SURVEY
+        §2.9 P2 / JDBCPEvents.scala:89-101): a multi-host loader's
+        process p reads only frags[p::count], so no process pulls the
+        full event set. Sharded reads order within the shard only."""
         ns = self._check_ns(app_id, channel_id)
         t = self._filter_rows(
-            self._read_all(ns), start_time, until_time, entity_type,
-            entity_id, event_names, target_entity_type, target_entity_id)
+            self._read_all(ns, shard=shard), start_time, until_time,
+            entity_type, entity_id, event_names, target_entity_type,
+            target_entity_id)
         if t.num_rows:
             t = t.sort_by([("eventTime",
                             "descending" if reversed_order else "ascending")])

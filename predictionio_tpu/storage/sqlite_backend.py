@@ -17,7 +17,7 @@ import json
 import sqlite3
 import threading
 from pathlib import Path
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import UTC, Event, millis as _to_ms
@@ -206,11 +206,34 @@ class SqliteEvents(base.EventStore):
         limit: Optional[int] = None,
         reversed_order: bool = False,
         ordered: bool = True,
+        shard: Optional[Tuple[int, int]] = None,
     ):
         """(sql, params) for a filtered event scan — shared by the row
-        path (`find`) and the columnar training path (`find_columnar`)."""
+        path (`find`) and the columnar training path (`find_columnar`).
+
+        ``shard=(index, count)`` restricts the scan to one of `count`
+        near-equal rowid ranges — the partitioned training read
+        (JDBCPEvents.scala:89-101's numeric range partitions): each
+        process of a multi-host run scans only its slice, so no process
+        ever pulls the full event set."""
         name = event_table_name(app_id, channel_id)
         where, params = ["1=1"], []
+        if shard is not None:
+            idx, count = shard
+            if not (0 <= idx < count):
+                raise ValueError(f"bad shard {shard}")
+            try:
+                row = self.client.conn().execute(
+                    f"SELECT MIN(rowid), MAX(rowid) FROM {name}").fetchone()
+            except sqlite3.OperationalError as ex:
+                raise StorageError(
+                    f"cannot read app {app_id} channel {channel_id}: {ex}"
+                ) from ex
+            lo_all, hi_all = (row[0] or 0), (row[1] or 0) + 1
+            span = -(-(hi_all - lo_all) // count)
+            where.append("rowid >= ? AND rowid < ?")
+            params.extend([lo_all + idx * span,
+                           lo_all + (idx + 1) * span])
         if start_time is not None:
             where.append("eventTime >= ?")
             params.append(_to_ms(start_time))

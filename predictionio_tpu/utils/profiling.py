@@ -10,8 +10,40 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import time
 
 logger = logging.getLogger("pio.profiling")
+
+_phase_sink = None
+
+
+@contextlib.contextmanager
+def collect_phases(sink: dict):
+    """Install `sink` to receive named host-phase durations (seconds)
+    recorded by `phase()` anywhere below this block — how the bench gets
+    per-phase breakdowns (build/transfer/...) out of model internals
+    without threading timing args through every signature."""
+    global _phase_sink
+    old, _phase_sink = _phase_sink, sink
+    try:
+        yield sink
+    finally:
+        _phase_sink = old
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Accumulate this block's wall time into the installed sink (no-op
+    when none is installed — zero overhead outside profiling)."""
+    if _phase_sink is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _phase_sink[name] = _phase_sink.get(name, 0.0) \
+            + time.perf_counter() - t0
 
 
 @contextlib.contextmanager

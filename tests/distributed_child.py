@@ -35,6 +35,100 @@ def make_toy_ratings():
             n_users, n_items)
 
 
+def make_toy_sessions():
+    """Deterministic sessions with a cyclic successor pattern: both
+    processes derive the identical list (replicated dp inputs), so the
+    cross-process tensor-parallel train is reproducible."""
+    return [[f"i{(s + j) % 6}" for j in range(5)] for s in range(24)]
+
+
+def _phase_als_store(mesh, pid, nproc, store_dir):
+    """P2 end-to-end: partitioned storage read -> collective vocab ->
+    all_to_all row exchange -> local pack -> sharded train. Neither
+    process ever holds the full event set (asserted)."""
+    import numpy as np
+
+    from predictionio_tpu.models.als import ALSParams, build_distributed, \
+        train_als
+    from predictionio_tpu.parallel.shuffle import allgather_object, \
+        global_vocab
+    from predictionio_tpu.storage.parquet_events import (
+        ParquetEvents, ParquetEventsClient)
+
+    store = ParquetEvents(ParquetEventsClient(store_dir))
+    t = store.find_columnar(1, ordered=False, shard=(pid, nproc))
+    uid = np.asarray(t.column("entity_id"))
+    iid = np.asarray(t.column("target_entity_id"))
+    ratings = np.asarray([json.loads(p)["rating"]
+                          for p in t.column("properties").to_pylist()],
+                         np.float32)
+
+    local_n = len(ratings)
+    total_n = sum(allgather_object(local_n))
+    assert 0 < local_n < total_n, (
+        f"process {pid} read {local_n}/{total_n} events — the shard "
+        "read must be a strict subset")
+
+    # deterministic global ids WITHOUT any process seeing all events
+    uvocab = global_vocab(uid)
+    ivocab = global_vocab(iid)
+    u_idx = np.searchsorted(uvocab, uid).astype(np.int32)
+    i_idx = np.searchsorted(ivocab, iid).astype(np.int32)
+
+    data = build_distributed(mesh, u_idx, i_idx, ratings,
+                             len(uvocab), len(ivocab))
+    params = ALSParams(rank=4, num_iterations=3, chunk_size=64)
+    U, V = train_als(mesh, data, params)
+    return {"store_local_n": local_n, "store_total_n": total_n,
+            "store_U_row0": np.asarray(U[0]).tolist(),
+            "store_V_row0": np.asarray(V[0]).tolist(),
+            "store_n_users": len(uvocab), "store_n_items": len(ivocab),
+            "store_digest": data.digest}
+
+
+def _phase_seqrec_tp(pid, nproc):
+    """dp x tp mesh with the MODEL axis spanning both processes: the
+    embedding/ffn shards live on different hosts and every train step's
+    psums cross the process boundary."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.engines.sessionrec import AlgorithmParams
+    from predictionio_tpu.models.seqrec import train_seqrec
+
+    devices = np.asarray(jax.devices()).reshape(1, nproc)
+    mesh = Mesh(devices, axis_names=("data", "model"))
+    p = AlgorithmParams(d_model=16, n_heads=2, n_layers=1, max_len=8,
+                        epochs=4, batch_size=8)
+    model = train_seqrec(mesh, make_toy_sessions(), p)
+    recs = model.recommend_next(["i1", "i2", "i3"], 3)
+    emb = model.params["emb"]
+    return {"seqrec_top": [it for it, _ in recs],
+            "seqrec_emb_sum": float(np.abs(emb).sum()),
+            "seqrec_emb_shape": list(emb.shape)}
+
+
+def _phase_cooc(mesh, pid, nproc):
+    """Sharded cooccurrence from per-process pair shards: all_to_all
+    re-key, local incidence block, matmul with on-device gather."""
+    import numpy as np
+
+    from predictionio_tpu.models.cooccurrence import (
+        cooccurrence_topn_distributed)
+
+    rng = np.random.default_rng(21)
+    u = rng.integers(0, 40, 2000).astype(np.int32)
+    i = rng.integers(0, 30, 2000).astype(np.int32)
+    # each process contributes a DISJOINT slice (its "storage shard")
+    lo = pid * len(u) // nproc
+    hi = (pid + 1) * len(u) // nproc
+    vals, idx = cooccurrence_topn_distributed(
+        mesh, u[lo:hi], i[lo:hi], 40, 30, 5)
+    return {"cooc_vals_sum": float(vals.sum()),
+            "cooc_vals_row0": np.asarray(vals[0]).tolist()}
+
+
 def main() -> None:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -86,13 +180,24 @@ def main() -> None:
     assert wrote == (pid == 0), (
         f"process {pid} snapshot writes: expected {pid == 0}, got {wrote}")
 
-    print("RESULT " + json.dumps({
+    result = {
         "pid": pid,
         "U_sum": float(np.abs(U).sum()),
         "V_sum": float(np.abs(V).sum()),
         "U_row0": np.asarray(U[0]).tolist(),
         "V_row0": np.asarray(V[0]).tolist(),
-    }), flush=True)
+    }
+
+    # r5: the three additional families the multi-process runtime must
+    # prove (r4 verdict weak #4) — partitioned store reads feeding ALS,
+    # tensor-parallel seqrec across hosts, and sharded cooccurrence
+    store_dir = os.environ.get("PIO_DIST_STORE")
+    if store_dir:
+        result.update(_phase_als_store(mesh, pid, nproc, store_dir))
+    result.update(_phase_seqrec_tp(pid, nproc))
+    result.update(_phase_cooc(mesh, pid, nproc))
+
+    print("RESULT " + json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

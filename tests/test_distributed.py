@@ -26,11 +26,36 @@ def _free_port() -> int:
 CHILD = os.path.join(os.path.dirname(__file__), "distributed_child.py")
 
 
-def test_two_process_sharded_als_matches_single_process():
-    # hang protection comes from communicate(timeout=210) below
+def _make_store(tmpdir: str):
+    """Parquet event store pre-loaded with the toy ratings in FOUR
+    fragments, so shard=(p, 2) assigns each process a strict subset."""
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.storage.parquet_events import (
+        ParquetEvents, ParquetEventsClient)
+    from tests.distributed_child import make_toy_ratings
+
+    users, items, ratings, n_users, n_items = make_toy_ratings()
+    store = ParquetEvents(ParquetEventsClient(tmpdir))
+    store.init_channel(1)
+    events = [Event(event="rate", entity_type="user",
+                    entity_id=f"u{u:03d}", target_entity_type="item",
+                    target_entity_id=f"i{i:03d}",
+                    properties={"rating": float(r)})
+              for u, i, r in zip(users, items, ratings)]
+    q = -(-len(events) // 4)
+    for k in range(0, len(events), q):
+        store.insert_batch(events[k:k + q], 1)
+    return users, items, ratings, n_users, n_items
+
+
+def test_two_process_sharded_als_matches_single_process(tmp_path):
+    # hang protection comes from communicate(timeout=...) below
     port = _free_port()
+    store_dir = str(tmp_path / "events")
+    users, items, ratings, n_users, n_items = _make_store(store_dir)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PIO_DIST_STORE"] = store_dir
     procs = [
         subprocess.Popen(
             [sys.executable, CHILD, str(pid), "2", str(port)],
@@ -41,7 +66,7 @@ def test_two_process_sharded_als_matches_single_process():
     outs = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=210)
+            out, err = p.communicate(timeout=420)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -81,3 +106,55 @@ def test_two_process_sharded_als_matches_single_process():
                                atol=1e-4)
     np.testing.assert_allclose(np.asarray(V[0]), results[0]["V_row0"],
                                atol=1e-4)
+
+    # -- partitioned store read (P2 complete): strict-subset reads, and
+    # the exchanged+locally-packed train matches a single-process train
+    # of the same events with the same sorted-vocab ids
+    r0, r1 = results[0], results[1]
+    assert r0["store_local_n"] < r0["store_total_n"]
+    assert r0["store_local_n"] + r1["store_local_n"] == r0["store_total_n"]
+    assert r0["store_total_n"] == len(ratings)
+    uvocab = np.unique([f"u{u:03d}" for u in users])
+    ivocab = np.unique([f"i{i:03d}" for i in items])
+    u_idx = np.searchsorted(uvocab, [f"u{u:03d}" for u in users])
+    i_idx = np.searchsorted(ivocab, [f"i{i:03d}" for i in items])
+    sdata = ALSData.build(u_idx.astype(np.int32), i_idx.astype(np.int32),
+                          ratings, len(uvocab), len(ivocab), n_shards=2)
+    sU, sV = train_als(mesh, sdata, params)
+    # the partitioned build must digest identically to the single-process
+    # build of the same data (checkpoint fingerprints survive resuming on
+    # a different process count)
+    assert sdata.digest == r0["store_digest"], (
+        sdata.digest, r0["store_digest"])
+    np.testing.assert_allclose(np.asarray(sU[0]), r0["store_U_row0"],
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(sV[0]), r0["store_V_row0"],
+                               atol=1e-4)
+    np.testing.assert_allclose(r0["store_U_row0"], r1["store_U_row0"],
+                               atol=1e-5)
+
+    # -- seqrec with the MODEL axis spanning both processes: both hosts
+    # extract the identical full (gathered) model, and the cross-host
+    # tensor-parallel train actually learns the cyclic successor
+    # (vocab pads to the tp multiple, so exact single-process parity is
+    # not expected — the softmax normalizes over the padded vocab)
+    assert r0["seqrec_top"] == r1["seqrec_top"]
+    np.testing.assert_allclose(r0["seqrec_emb_sum"], r1["seqrec_emb_sum"],
+                               rtol=1e-5)
+    assert "i4" in r0["seqrec_top"], r0["seqrec_top"]
+    assert r0["seqrec_emb_shape"][0] % 2 == 0   # padded to tp=2 multiple
+
+    # -- sharded cooccurrence from disjoint pair shards matches a
+    # single-device run over the union of the shards
+    from predictionio_tpu.models.cooccurrence import (
+        cooccurrence_topn, distinct_pairs)
+    rng = np.random.default_rng(21)
+    cu = rng.integers(0, 40, 2000).astype(np.int32)
+    ci = rng.integers(0, 30, 2000).astype(np.int32)
+    du, di = distinct_pairs(cu, ci)
+    mesh1 = Mesh(np.asarray(jax.devices()[:1]), axis_names=("data",))
+    cv, _ = cooccurrence_topn(mesh1, du, di, 40, 30, 5)
+    np.testing.assert_allclose(float(cv.sum()), r0["cooc_vals_sum"])
+    np.testing.assert_allclose(np.asarray(cv[0], np.float64).tolist(),
+                               r0["cooc_vals_row0"])
+    assert r0["cooc_vals_sum"] == r1["cooc_vals_sum"]

@@ -1007,6 +1007,12 @@ class Suite:
         peak = peak_flops(detail.get("device_kind", ""))
         if peak and detail.get("model_flops") and e:
             detail["mfu"] = round(detail["model_flops"] / e / peak, 5)
+        elif detail.get("model_flops") and e:
+            # unknown chip / CPU fallback: no MFU claim, but emit the
+            # achieved model-flop rate so perf trends stay measurable
+            # across rounds even when the TPU is down (r4 verdict weak #7)
+            detail["achieved_gflops_per_s"] = round(
+                detail["model_flops"] / e / 1e9, 2)
         detail.pop("model_flops", None)
         self.details.append(detail)
         log(f"{name}: {json.dumps(detail)}")

@@ -76,10 +76,11 @@ def exchange_rows(dest: np.ndarray, payload: np.ndarray) -> np.ndarray:
     [m, k] rows destined to THIS process, grouped by source process and
     preserving each source's local order within the group.
 
-    Mechanics: bin rows by dest, pad bins to the global max (exchanged
-    via one tiny metadata all-gather), stack into [P, M, k+1] with a
-    validity flag column, and run one jitted shard_map all_to_all over
-    the process mesh. Single-process: a pass-through reorder.
+    Mechanics: bin rows by dest, pad bins to the global max (the exact
+    per-(source, dest) counts ride one tiny metadata all-gather and
+    delimit the unbinning — padding rows are simply never sliced in),
+    and run one jitted shard_map all_to_all over the process mesh.
+    Single-process: a pass-through reorder.
     """
     import jax
 
@@ -97,17 +98,16 @@ def exchange_rows(dest: np.ndarray, payload: np.ndarray) -> np.ndarray:
     all_counts = np.stack(allgather_object(counts))    # [P src, P dst]
     m = int(all_counts.max())
 
-    send = np.zeros((nproc, m, k + 1), np.int32)
+    send = np.zeros((nproc, m, k), np.int32)
     for d in range(nproc):
         lo, hi = int(starts[d]), int(starts[d + 1])
-        send[d, :hi - lo, :k] = payload_s[lo:hi]
-        send[d, :hi - lo, k] = 1                   # validity flag
+        send[d, :hi - lo] = payload_s[lo:hi]
 
-    recv = _all_to_all(send)                       # [P src, m, k+1]
+    recv = _all_to_all(send)                       # [P src, m, k]
     rows = []
     for s in range(nproc):
         cnt = int(all_counts[s, me])
-        rows.append(recv[s, :cnt, :k])
+        rows.append(recv[s, :cnt])
     out = np.concatenate(rows) if rows else np.zeros((0, k), np.int32)
     assert out.shape[0] == int(all_counts[:, me].sum())
     return out
@@ -117,23 +117,33 @@ def _all_to_all(send: np.ndarray) -> np.ndarray:
     """One lax.all_to_all step: send[d] goes to process d; returns
     recv[s] = the block process s sent here."""
     import jax
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.ops.fn_cache import mesh_cached_fn
 
     mesh = _exchange_mesh()
     nproc, m, kk = send.shape
 
-    def step(x):            # local block [1, nproc, m, kk]
-        return jax.lax.all_to_all(
-            x, "proc", split_axis=1, concat_axis=0)
+    def build():
+        from jax import shard_map
 
-    sharded = shard_map(step, mesh=mesh, in_specs=P("proc"),
-                        out_specs=P(None, "proc"), check_vma=False)
+        def step(x):        # local block [1, nproc, m, kk]
+            return jax.lax.all_to_all(
+                x, "proc", split_axis=1, concat_axis=0)
+
+        return jax.jit(shard_map(
+            step, mesh=mesh, in_specs=P("proc"),
+            out_specs=P(None, "proc"), check_vma=False))
+
+    # cached per (mesh, shape): a per-call jit(shard_map(closure)) would
+    # re-trace every exchange (the ops/fn_cache rule; Mesh hashes by
+    # devices+axis names, so the freshly-built equal mesh still hits)
+    run = mesh_cached_fn("shuffle_all_to_all", mesh, (nproc, m, kk), build)
 
     global_shape = (nproc, nproc, m, kk)
     arr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P("proc")), send[None], global_shape)
-    out = jax.jit(sharded)(arr)
+    out = run(arr)
     # each process's addressable slice of the axis-1-sharded result is
     # exactly its received blocks [nproc, 1, m, kk]
     local = [s.data for s in out.addressable_shards]

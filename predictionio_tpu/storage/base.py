@@ -373,10 +373,13 @@ class EventStore(abc.ABC):
         math is permutation-invariant — the JdbcRDD-partition contract)
         accepts ARBITRARY row order; backends may then skip the time sort.
         The default keeps the row path's chronological guarantee (exports,
-        dumps). ``shard=(index, count)`` restricts the scan to one of
-        `count` disjoint row partitions (the multi-host partitioned
-        training read); backends that cannot partition must refuse rather
-        than silently hand every process the full set. Default
+        dumps). ``shard=(index, count[, snapshot])`` restricts the scan
+        to one of `count` disjoint row partitions (the multi-host
+        partitioned training read); multi-process readers must agree on
+        one `read_snapshot()` token (third element) so concurrent ingest
+        cannot skew the partition bounds between them. Backends that
+        cannot partition must refuse rather than silently hand every
+        process the full set. Default
         implementation materializes through `find`; columnar backends
         override with a direct scan.
         """

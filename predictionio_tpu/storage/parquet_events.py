@@ -169,13 +169,24 @@ class ParquetEvents(base.EventStore):
         with self.client.fs.open(path, "wb") as f:
             pq.write_table(table, f)
 
+    def read_snapshot(self, app_id: int,
+                      channel_id: Optional[int] = None) -> List[str]:
+        """Stable fragment list for partitioned reads: capture ONCE (on
+        one process), broadcast, and pass as shard=(idx, count, snapshot)
+        so every reader partitions the SAME fragments even while writers
+        keep appending new ones."""
+        return self._fragments(self._check_ns(app_id, channel_id))
+
     def _read_all(self, ns: str, shard=None) -> pa.Table:
-        frags = self._fragments(ns)
         if shard is not None:
-            idx, count = shard
+            idx, count = shard[0], shard[1]
             if not (0 <= idx < count):
                 raise StorageError(f"bad shard {shard}")
+            frags = (list(shard[2]) if len(shard) > 2 and shard[2]
+                     is not None else self._fragments(ns))
             frags = frags[idx::count]
+        else:
+            frags = self._fragments(ns)
         if not frags:
             return STORE_SCHEMA.empty_table()
         tables = []
@@ -241,11 +252,14 @@ class ParquetEvents(base.EventStore):
     ) -> pa.Table:
         """Vectorized filter over all fragments — the training hot path.
 
-        ``shard=(index, count)`` assigns whole FRAGMENTS round-robin to
-        one of `count` readers (the partitioned training read, SURVEY
-        §2.9 P2 / JDBCPEvents.scala:89-101): a multi-host loader's
-        process p reads only frags[p::count], so no process pulls the
-        full event set. Sharded reads order within the shard only."""
+        ``shard=(index, count[, snapshot])`` assigns whole FRAGMENTS
+        round-robin to one of `count` readers (the partitioned training
+        read, SURVEY §2.9 P2 / JDBCPEvents.scala:89-101): a multi-host
+        loader's process p reads only frags[p::count], so no process
+        pulls the full event set. Multi-process readers must share a
+        `read_snapshot()` fragment list (third element) — independently
+        listed fragments skew under concurrent ingest and the partitions
+        gap/overlap. Sharded reads order within the shard only."""
         ns = self._check_ns(app_id, channel_id)
         t = self._filter_rows(
             self._read_all(ns, shard=shard), start_time, until_time,

@@ -206,30 +206,33 @@ class SqliteEvents(base.EventStore):
         limit: Optional[int] = None,
         reversed_order: bool = False,
         ordered: bool = True,
-        shard: Optional[Tuple[int, int]] = None,
+        shard: Optional[Tuple] = None,
     ):
         """(sql, params) for a filtered event scan — shared by the row
         path (`find`) and the columnar training path (`find_columnar`).
 
-        ``shard=(index, count)`` restricts the scan to one of `count`
-        near-equal rowid ranges — the partitioned training read
+        ``shard=(index, count[, snapshot])`` restricts the scan to one of
+        `count` near-equal rowid ranges — the partitioned training read
         (JDBCPEvents.scala:89-101's numeric range partitions): each
         process of a multi-host run scans only its slice, so no process
-        ever pulls the full event set."""
+        ever pulls the full event set. Multi-process readers must share
+        one `read_snapshot()` window (third element) — independently
+        computed bounds skew under concurrent ingest and the partitions
+        gap/overlap."""
         name = event_table_name(app_id, channel_id)
         where, params = ["1=1"], []
         if shard is not None:
-            idx, count = shard
+            idx, count = shard[0], shard[1]
             if not (0 <= idx < count):
-                raise ValueError(f"bad shard {shard}")
-            try:
-                row = self.client.conn().execute(
-                    f"SELECT MIN(rowid), MAX(rowid) FROM {name}").fetchone()
-            except sqlite3.OperationalError as ex:
-                raise StorageError(
-                    f"cannot read app {app_id} channel {channel_id}: {ex}"
-                ) from ex
-            lo_all, hi_all = (row[0] or 0), (row[1] or 0) + 1
+                raise StorageError(f"bad shard {shard}")
+            if len(shard) > 2 and shard[2] is not None:
+                # pre-agreed snapshot window: multi-process readers MUST
+                # share one (read_snapshot + a collective broadcast) or
+                # concurrent ingest skews each process's bounds and the
+                # partitions gap/overlap
+                lo_all, hi_all = shard[2]
+            else:
+                lo_all, hi_all = self.read_snapshot(app_id, channel_id)
             span = -(-(hi_all - lo_all) // count)
             where.append("rowid >= ? AND rowid < ?")
             params.extend([lo_all + idx * span,
@@ -269,6 +272,23 @@ class SqliteEvents(base.EventStore):
             sql += " LIMIT ?"
             params.append(limit)
         return sql, params
+
+    def read_snapshot(self, app_id: int,
+                      channel_id: Optional[int] = None) -> Tuple[int, int]:
+        """Stable row window [lo, hi) for partitioned reads: capture ONCE
+        (on one process), broadcast, and pass as shard=(idx, count,
+        snapshot) so every reader partitions the SAME set even while an
+        event server keeps ingesting (rows landing after the snapshot are
+        simply not part of this training read)."""
+        name = event_table_name(app_id, channel_id)
+        try:
+            row = self.client.conn().execute(
+                f"SELECT MIN(rowid), MAX(rowid) FROM {name}").fetchone()
+        except sqlite3.OperationalError as ex:
+            raise StorageError(
+                f"cannot read app {app_id} channel {channel_id}: {ex}"
+            ) from ex
+        return (row[0] or 0), (row[1] or 0) + 1
 
     def find(self, app_id: int, channel_id: Optional[int] = None,
              **filters) -> Iterator[Event]:

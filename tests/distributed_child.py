@@ -56,7 +56,11 @@ def _phase_als_store(mesh, pid, nproc, store_dir):
         ParquetEvents, ParquetEventsClient)
 
     store = ParquetEvents(ParquetEventsClient(store_dir))
-    t = store.find_columnar(1, ordered=False, shard=(pid, nproc))
+    # one process captures the fragment snapshot; everyone partitions the
+    # SAME list (concurrent ingest must not skew the shard bounds)
+    snap = allgather_object(
+        store.read_snapshot(1) if pid == 0 else None)[0]
+    t = store.find_columnar(1, ordered=False, shard=(pid, nproc, snap))
     uid = np.asarray(t.column("entity_id"))
     iid = np.asarray(t.column("target_entity_id"))
     ratings = np.asarray([json.loads(p)["rating"]

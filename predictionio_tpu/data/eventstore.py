@@ -123,6 +123,18 @@ class EventStoreClient:
         app_id, channel_id = resolve_app(app_name, channel_name)
         return Storage.get_events().find_columnar(app_id, channel_id, **filters)
 
+    @staticmethod
+    def read_snapshot(app_name: str, channel_name: Optional[str] = None):
+        """Partitioned-read snapshot token for the configured backend
+        (sqlite rowid window / parquet fragment list), or None when the
+        backend cannot partition. Multi-host trainers capture this ONCE,
+        broadcast it, and pass shard=(index, count, snapshot) to
+        find_columnar so every process reads the same stable set."""
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        store = Storage.get_events()
+        fn = getattr(store, "read_snapshot", None)
+        return fn(app_id, channel_id) if fn is not None else None
+
 
 # short aliases mirroring the reference object names
 PEventStore = EventStoreClient

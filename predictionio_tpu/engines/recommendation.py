@@ -160,17 +160,36 @@ class RecommendationDataSource(DataSource):
 
     def _read_columns(self) -> RatingColumns:
         """Columnar training read (find_columnar -> arrays), the
-        JDBCPEvents-into-RDD analog without per-event objects."""
+        JDBCPEvents-into-RDD analog without per-event objects.
+
+        On a multi-process runtime this read is PARTITIONED exactly like
+        the reference's per-executor JdbcRDD slices
+        (JDBCPEvents.scala:89-101): every process reads only its shard of
+        one collectively-agreed snapshot, and the downstream algorithm
+        re-keys rows to their owners over the interconnect
+        (models/als.build_distributed) — no process materializes the full
+        event set."""
         from predictionio_tpu.data.columnar import property_column
 
         names = self.params.event_names or ["rate", "buy"]
         weights = {**self.DEFAULT_WEIGHTS, **(self.params.event_weights or {})}
+        shard = None
+        import jax
+
+        if jax.process_count() > 1:
+            from predictionio_tpu.parallel.shuffle import allgather_object
+
+            snap = allgather_object(
+                EventStoreClient.read_snapshot(self.params.app_name)
+                if jax.process_index() == 0 else None)[0]
+            shard = (jax.process_index(), jax.process_count(), snap)
         table = EventStoreClient.find_columnar(
             app_name=self.params.app_name,
             entity_type="user",
             event_names=names,
             target_entity_type="item",
-            ordered=False)     # rating math is permutation-invariant
+            ordered=False,     # rating math is permutation-invariant
+            shard=shard)
         events = np.asarray(table.column("event").to_pylist(), dtype=object)
         users = np.asarray(table.column("entity_id").to_pylist(),
                            dtype=object)
@@ -245,19 +264,45 @@ class ALSAlgorithm(Algorithm):
         self.params = params or AlgorithmParams()
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
-        if not len(pd):
+        import jax
+
+        n_local = len(pd)
+        if jax.process_count() > 1:
+            # the emptiness that matters is GLOBAL: a process whose
+            # storage shard is legitimately empty must still join the
+            # collectives below, not raise while its peers block
+            from predictionio_tpu.parallel.shuffle import allgather_object
+
+            n_local = sum(allgather_object(n_local))
+        if not n_local:
             raise ValueError(
                 "No ratings found. Check the appName or import data first "
                 "(ALSAlgorithm.scala:55 empty-check parity).")
+
         cols = pd.as_columns()
         users, items, values = cols.users, cols.items, cols.values
-        user_vocab, user_codes = assign_indices(users)
-        item_vocab, item_codes = assign_indices(items)
         from predictionio_tpu.workflow.context import mesh_of
         mesh = mesh_of(ctx)
-        n_shards = int(np.prod(mesh.devices.shape))
-        data = ALSData.build(user_codes, item_codes, values,
-                             len(user_vocab), len(item_vocab), n_shards)
+        if jax.process_count() > 1:
+            # partitioned pipeline (P2+P4): `users`/`items` hold only this
+            # process's storage shard; ids come from a collective vocab
+            # union and rows reach their segment owners via one
+            # all_to_all inside build_distributed
+            from predictionio_tpu.models.als import build_distributed
+            from predictionio_tpu.parallel.shuffle import global_vocab
+
+            user_vocab = global_vocab(np.asarray(users))
+            item_vocab = global_vocab(np.asarray(items))
+            user_codes = np.searchsorted(user_vocab, users).astype(np.int32)
+            item_codes = np.searchsorted(item_vocab, items).astype(np.int32)
+            data = build_distributed(mesh, user_codes, item_codes, values,
+                                     len(user_vocab), len(item_vocab))
+        else:
+            user_vocab, user_codes = assign_indices(users)
+            item_vocab, item_codes = assign_indices(items)
+            n_shards = int(np.prod(mesh.devices.shape))
+            data = ALSData.build(user_codes, item_codes, values,
+                                 len(user_vocab), len(item_vocab), n_shards)
         als_params = ALSParams(
             rank=self.params.rank,
             num_iterations=self.params.num_iterations,

@@ -235,8 +235,11 @@ class SqliteEvents(base.EventStore):
                 lo_all, hi_all = self.read_snapshot(app_id, channel_id)
             span = -(-(hi_all - lo_all) // count)
             where.append("rowid >= ? AND rowid < ?")
+            # clamp to the snapshot's end: the last partition's arithmetic
+            # bound can exceed hi_all and would leak rows ingested after
+            # the snapshot into this read
             params.extend([lo_all + idx * span,
-                           lo_all + (idx + 1) * span])
+                           min(lo_all + (idx + 1) * span, hi_all)])
         if start_time is not None:
             where.append("eventTime >= ?")
             params.append(_to_ms(start_time))

@@ -90,6 +90,41 @@ def _phase_als_store(mesh, pid, nproc, store_dir):
             "store_digest": data.digest}
 
 
+def _phase_engine_train(mesh, pid, nproc, db_path):
+    """The DASE layer end-to-end on the multi-process runtime: the
+    recommendation DataSource shards its columnar read transparently
+    (snapshot broadcast + shard=(p, P, snap)) and ALSAlgorithm routes
+    through build_distributed — `pio train` semantics, partitioned."""
+    import types
+
+    import numpy as np
+
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, DataSourceParams,
+        RecommendationDataSource, RecommendationPreparator)
+    from predictionio_tpu.storage import Storage
+
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": db_path}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    ds = RecommendationDataSource(DataSourceParams(app_name="DistApp"))
+    td = ds.read_training(None)
+    local_rows = len(td.columns.users)
+    pd = RecommendationPreparator().prepare(None, td)
+    algo = ALSAlgorithm(AlgorithmParams(rank=4, num_iterations=3))
+    ctx = types.SimpleNamespace(mesh=mesh, checkpointer=None)
+    model = algo.train(ctx, pd)
+    return {"engine_local_rows": local_rows,
+            "engine_U_row0": np.asarray(model.U[0]).tolist(),
+            "engine_n_users": len(model.user_vocab),
+            "engine_n_items": len(model.item_vocab)}
+
+
 def _phase_seqrec_tp(pid, nproc):
     """dp x tp mesh with the MODEL axis spanning both processes: the
     embedding/ffn shards live on different hosts and every train step's
@@ -198,6 +233,9 @@ def main() -> None:
     store_dir = os.environ.get("PIO_DIST_STORE")
     if store_dir:
         result.update(_phase_als_store(mesh, pid, nproc, store_dir))
+    db_path = os.environ.get("PIO_DIST_DB")
+    if db_path:
+        result.update(_phase_engine_train(mesh, pid, nproc, db_path))
     result.update(_phase_seqrec_tp(pid, nproc))
     result.update(_phase_cooc(mesh, pid, nproc))
 

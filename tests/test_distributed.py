@@ -48,14 +48,46 @@ def _make_store(tmpdir: str):
     return users, items, ratings, n_users, n_items
 
 
+def _make_engine_db(db_path: str):
+    """Sqlite store + app metadata so the DASE DataSource path can run
+    its partitioned read through the real registry/facade."""
+    from predictionio_tpu.data import Event
+    from predictionio_tpu.storage import App, Storage
+    from tests.distributed_child import make_toy_ratings
+
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": db_path}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    from predictionio_tpu.data.eventstore import clear_cache
+    clear_cache()
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="DistApp"))
+    store = Storage.get_events()
+    store.init_channel(app_id)
+    users, items, ratings, *_ = make_toy_ratings()
+    store.insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=f"u{u:03d}",
+               target_entity_type="item", target_entity_id=f"i{i:03d}",
+               properties={"rating": float(r)})
+         for u, i, r in zip(users, items, ratings)], app_id)
+
+
 def test_two_process_sharded_als_matches_single_process(tmp_path):
     # hang protection comes from communicate(timeout=...) below
     port = _free_port()
     store_dir = str(tmp_path / "events")
     users, items, ratings, n_users, n_items = _make_store(store_dir)
+    db_path = str(tmp_path / "engine.db")
+    _make_engine_db(db_path)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PIO_DIST_STORE"] = store_dir
+    env["PIO_DIST_DB"] = db_path
     procs = [
         subprocess.Popen(
             [sys.executable, CHILD, str(pid), "2", str(port)],
@@ -131,6 +163,16 @@ def test_two_process_sharded_als_matches_single_process(tmp_path):
     np.testing.assert_allclose(np.asarray(sV[0]), r0["store_V_row0"],
                                atol=1e-4)
     np.testing.assert_allclose(r0["store_U_row0"], r1["store_U_row0"],
+                               atol=1e-5)
+
+    # -- DASE layer: the engine DataSource's partitioned read + algorithm
+    # build_distributed, through the real registry/facade. Each process
+    # read a strict subset, both produced identical full factor models
+    assert 0 < r0["engine_local_rows"] < len(ratings)
+    assert r0["engine_local_rows"] + r1["engine_local_rows"] == len(ratings)
+    assert r0["engine_n_users"] == n_users
+    assert r0["engine_n_items"] == n_items
+    np.testing.assert_allclose(r0["engine_U_row0"], r1["engine_U_row0"],
                                atol=1e-5)
 
     # -- seqrec with the MODEL axis spanning both processes: both hosts

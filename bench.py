@@ -366,6 +366,20 @@ def setup_backend(platform: str):
 # Configs — each returns a detail dict
 # ---------------------------------------------------------------------------
 
+def timed_best(fn, repeats: int = 3):
+    """min-of-N wall time for a sub-second timed region (standard
+    microbenchmark practice): the tunneled chip's relay exhibits
+    occasional 0.5-1s pipeline stalls that would otherwise swamp a
+    ~100ms steady-state measurement. Returns (best_seconds, last_result).
+    min, not mean — stalls are additive noise, never speedups."""
+    best, result = float("inf"), None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, result
+
+
 def _als_device_data(jax, mesh, users, items, ratings, nu, ni):
     """ALSData built on host then committed to the mesh ONCE — the timed
     train consumes resident arrays, so tunnel transfer time is reported
@@ -400,9 +414,7 @@ def cfg_als_ml100k(jax, mesh, platform):
     train_als(mesh, data, params)          # warm-up (compile + first run)
     warm_s = time.perf_counter() - t0
     hb("als_ml100k train")
-    t0 = time.perf_counter()
-    U, V = train_als(mesh, data, params)
-    elapsed = time.perf_counter() - t0
+    elapsed, (U, V) = timed_best(lambda: train_als(mesh, data, params))
     err = als_rmse(U, V, users, items, ratings)
     assert np.isfinite(err), "ALS diverged"
     flops = als_model_flops(nnz, nu, ni, RANK, ITERS)
@@ -411,7 +423,7 @@ def cfg_als_ml100k(jax, mesh, platform):
             "transfer_s": round(transfer_s, 3),
             "compile_s": round(warm_s - elapsed, 3),
             "model_flops": flops,
-            "note": f"train-RMSE {err:.3f}"}
+            "note": f"train-RMSE {err:.3f}; best of 3"}
 
 
 def cfg_pipeline_ml100k(jax, mesh, platform):
@@ -634,9 +646,8 @@ def cfg_cooccurrence(jax, mesh, platform):
         cooccurrence_topn(mesh, users, items, nu, ni, n_top)
         cold = time.perf_counter() - t0
     hb("cooccurrence timed")
-    t0 = time.perf_counter()
-    scores, idx = cooccurrence_topn(mesh, users, items, nu, ni, n_top)
-    elapsed = time.perf_counter() - t0
+    elapsed, _ = timed_best(
+        lambda: cooccurrence_topn(mesh, users, items, nu, ni, n_top))
     # matmul-dominated: A^T A is 2 * nu * ni^2 flops
     flops = 2.0 * nu * ni * ni
     build_s = ph.get("incidence_build", 0.0)
@@ -647,8 +658,8 @@ def cfg_cooccurrence(jax, mesh, platform):
             "compile_s": round(cold - elapsed - build_s - transfer_s, 3),
             "model_flops": flops,
             "note": f"{len(users)} distinct pairs; steady-state counts on "
-                    f"a resident incidence matrix (cold build+upload+compile "
-                    f"reported separately)"}
+                    f"a resident incidence matrix, best of 3 (cold "
+                    f"build+upload+compile reported separately)"}
 
 
 def cfg_naive_bayes(jax, mesh, platform):
@@ -664,20 +675,20 @@ def cfg_naive_bayes(jax, mesh, platform):
         model = train_multinomial_nb(X, labels, mesh=mesh)
         model.predict(X)           # compile the score matmul too
     hb("naive_bayes timed")
-    t0 = time.perf_counter()
-    model = train_multinomial_nb(X, labels, mesh=mesh)
-    t1 = time.perf_counter()
-    pred = model.predict(X)
-    elapsed = time.perf_counter() - t0
+    train_s, model = timed_best(
+        lambda: train_multinomial_nb(X, labels, mesh=mesh))
+    predict_s, pred = timed_best(lambda: model.predict(X))
+    elapsed = train_s + predict_s
     acc = float((pred == labels).mean())
     assert acc > 0.9, f"NB accuracy {acc}"
     return {"elapsed_s": round(elapsed, 4),
-            "train_s": round(t1 - t0, 4),
-            "predict_s": round(elapsed - (t1 - t0), 4),
+            "train_s": round(train_s, 4),
+            "predict_s": round(predict_s, 4),
             "compact_s": round(ph.get("nb_compact", 0.0), 3),
             "transfer_s": round(ph.get("nb_transfer", 0.0), 3),
             "note": f"accuracy {acc:.3f}; steady-state train+predict on a "
-                    f"resident X (cold compact+upload reported separately)"}
+                    f"resident X, each best of 3 (cold compact+upload "
+                    f"reported separately)"}
 
 
 def cfg_ecommerce(jax, mesh, platform):
@@ -704,14 +715,17 @@ def cfg_ecommerce(jax, mesh, platform):
     U, V = train_als(mesh, data, params)   # warm-up train ...
     jax.block_until_ready(topn(jnp.asarray(U), jnp.asarray(V)))
     hb("ecommerce timed")
-    t0 = time.perf_counter()
-    U, V = train_als(mesh, data, params)
-    scores, idx = topn(jnp.asarray(U), jnp.asarray(V))
-    jax.block_until_ready((scores, idx))
-    elapsed = time.perf_counter() - t0
+
+    def run_once():
+        U, V = train_als(mesh, data, params)
+        out = topn(jnp.asarray(U), jnp.asarray(V))
+        jax.block_until_ready(out)
+        return out
+
+    elapsed, _ = timed_best(run_once)
     flops = als_model_flops(nnz, nu, ni, RANK, iters)
     return {"elapsed_s": round(elapsed, 4), "model_flops": flops,
-            "note": "implicit ALS + batch top-10"}
+            "note": "implicit ALS + batch top-10; best of 3"}
 
 
 def cfg_eval_sweep(jax, mesh, platform):

@@ -182,7 +182,12 @@ class RecommendationDataSource(DataSource):
             snap = allgather_object(
                 EventStoreClient.read_snapshot(self.params.app_name)
                 if jax.process_index() == 0 else None)[0]
-            shard = (jax.process_index(), jax.process_count(), snap)
+            if snap is not None:
+                shard = (jax.process_index(), jax.process_count(), snap)
+            # snap None = the backend cannot partition (no
+            # read_snapshot): degrade to the pre-partitioned behavior —
+            # every process reads the full set — rather than refusing
+            # to train at all
         table = EventStoreClient.find_columnar(
             app_name=self.params.app_name,
             entity_type="user",
@@ -208,7 +213,15 @@ class RecommendationDataSource(DataSource):
             # value the mask immediately discards)
             values[is_rate] = property_column(
                 table.filter(pa.array(is_rate)), "rating")
-        if np.isnan(values[is_rate]).any():
+        bad = bool(np.isnan(values[is_rate]).any())
+        if shard is not None:
+            # data errors live in ONE process's shard; the raise must be
+            # COLLECTIVE or the erroring process dies while its peers
+            # block forever in the training collectives downstream
+            from predictionio_tpu.parallel.shuffle import allgather_object
+
+            bad = any(allgather_object(bad))
+        if bad:
             raise ValueError(
                 "rate event without a rating property "
                 "(DataSource.scala:66 MatchError parity)")

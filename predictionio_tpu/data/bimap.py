@@ -107,6 +107,45 @@ def vocab_index(vocab: np.ndarray, key: str) -> "int | None":
     return None
 
 
+def _assign_indices_u64(arr: np.ndarray):
+    """Fast path for short ASCII ids (<= 8 chars, the ML-20M shape):
+    null-padded bytes viewed as BIG-endian uint64 compare exactly like
+    the strings (lexicographic bytes == unicode order for ASCII, and the
+    null padding ranks shorter prefixes first), so the whole distinct +
+    sort pipeline runs on machine integers — ~5x faster than string
+    factorize at 20M ids. Returns None when the precondition fails
+    (long or non-ASCII ids) and the caller falls through."""
+    if arr.dtype.kind != "U" or arr.dtype.itemsize > 32 or arr.size == 0:
+        return None
+    n_chars = arr.dtype.itemsize // 4
+    # numpy unicode is UTF-32: view the raw codepoints with zero copies
+    cps = np.ascontiguousarray(arr).view(np.uint32).reshape(-1, n_chars)
+    if cps.max(initial=0) > 127:
+        return None                     # non-ASCII: byte order != str order
+    # pack the (null-padded) codepoint bytes big-endian so integer
+    # comparison == lexicographic string comparison
+    packed = np.zeros((len(arr), 8), np.uint8)
+    packed[:, :n_chars] = cps.astype(np.uint8)
+    ints = packed.view(">u8").reshape(-1).astype(np.uint64)  # zero-copy view
+    try:
+        import pandas as pd
+
+        raw, uniq = pd.factorize(ints.view(np.int64), sort=False)
+        uniq = uniq.view(np.uint64)
+        order = np.argsort(uniq)        # sort only the DISTINCT ints
+        rank = np.empty(len(order), np.int32)
+        rank[order] = np.arange(len(order), dtype=np.int32)
+        codes, uniq_int = rank[raw], uniq[order]
+    except ImportError:
+        uniq_int, codes = np.unique(ints, return_inverse=True)
+        codes = codes.astype(np.int32)
+    # rebuild the vocab strings from the sorted distinct ints (small)
+    ub = uniq_int.astype(">u8").view(np.uint8).reshape(-1, 8)[:, :n_chars]
+    vocab = np.ascontiguousarray(
+        ub.astype(np.uint32)).view(arr.dtype).reshape(-1)
+    return vocab, codes
+
+
 def assign_indices(values: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
     """Vectorized distinct-id assignment for the training path.
 
@@ -119,6 +158,9 @@ def assign_indices(values: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
     vocab contract `vocab_index` relies on; numpy fallback otherwise.
     """
     arr = np.asarray(values)
+    fast = _assign_indices_u64(arr)
+    if fast is not None:
+        return fast
     try:
         import pandas as pd
     except ImportError:

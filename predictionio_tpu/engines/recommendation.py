@@ -185,9 +185,10 @@ class RecommendationDataSource(DataSource):
             if snap is not None:
                 shard = (jax.process_index(), jax.process_count(), snap)
             # snap None = the backend cannot partition (no
-            # read_snapshot): degrade to the pre-partitioned behavior —
-            # every process reads the full set — rather than refusing
-            # to train at all
+            # read_snapshot): every process reads the full set (the
+            # pre-partitioned cost) but must then keep a DISJOINT local
+            # slice — the distributed build downstream exchanges rows by
+            # owner and would double-count replicated reads
         table = EventStoreClient.find_columnar(
             app_name=self.params.app_name,
             entity_type="user",
@@ -214,7 +215,7 @@ class RecommendationDataSource(DataSource):
             values[is_rate] = property_column(
                 table.filter(pa.array(is_rate)), "rating")
         bad = bool(np.isnan(values[is_rate]).any())
-        if shard is not None:
+        if jax.process_count() > 1:
             # data errors live in ONE process's shard; the raise must be
             # COLLECTIVE or the erroring process dies while its peers
             # block forever in the training collectives downstream
@@ -225,6 +226,13 @@ class RecommendationDataSource(DataSource):
             raise ValueError(
                 "rate event without a rating property "
                 "(DataSource.scala:66 MatchError parity)")
+        if jax.process_count() > 1 and shard is None:
+            # replicated read (backend couldn't partition): keep a
+            # disjoint strided slice so the distributed build's
+            # exchange-by-owner sees each rating exactly once
+            p, np_ = jax.process_index(), jax.process_count()
+            return RatingColumns(users=users[p::np_], items=items[p::np_],
+                                 values=values[p::np_])
         return RatingColumns(users=users, items=items, values=values)
 
     def read_training(self, ctx) -> TrainingData:

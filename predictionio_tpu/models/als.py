@@ -95,11 +95,18 @@ def _build_rows(seg_local: np.ndarray, tgt: np.ndarray, val: np.ndarray,
                 np.zeros((1, row_len), np.float32),
                 np.zeros((1, row_len), np.float32),
                 np.full((1,), seg_per_shard - 1, np.int32))
-    uniq, first_idx, counts = np.unique(
-        seg_local, return_index=True, return_counts=True)
+    # the input is SORTED by segment (both callers sort first), so the
+    # group structure falls out of one linear diff pass — np.unique would
+    # re-sort 20M elements it already received in order
+    new_seg = np.empty(n, bool)
+    new_seg[0] = True
+    np.not_equal(seg_local[1:], seg_local[:-1], out=new_seg[1:])
+    first_idx = np.flatnonzero(new_seg)            # [U] group starts
+    uniq = seg_local[first_idx]
+    counts = np.diff(np.append(first_idx, n))
     rows_per = -(-counts // row_len)
     row_start = np.concatenate([[0], np.cumsum(rows_per)])
-    inv = np.searchsorted(uniq, seg_local)
+    inv = np.cumsum(new_seg) - 1                   # group id per element
     pos = np.arange(n) - first_idx[inv]
     rrow = row_start[inv] + pos // row_len
     col = pos % row_len

@@ -119,10 +119,28 @@ def _phase_engine_train(mesh, pid, nproc, db_path):
     algo = ALSAlgorithm(AlgorithmParams(rank=4, num_iterations=3))
     ctx = types.SimpleNamespace(mesh=mesh, checkpointer=None)
     model = algo.train(ctx, pd)
+
+    # degrade path: a backend with no read_snapshot -> every process
+    # reads the full set but keeps a disjoint strided slice, so the
+    # distributed build still sees each rating exactly once
+    from predictionio_tpu.data import eventstore
+
+    orig = eventstore.EventStoreClient.read_snapshot
+    eventstore.EventStoreClient.read_snapshot = staticmethod(
+        lambda *a, **k: None)
+    try:
+        td2 = ds.read_training(None)
+        model2 = algo.train(
+            ctx, RecommendationPreparator().prepare(None, td2))
+    finally:
+        eventstore.EventStoreClient.read_snapshot = orig
+
     return {"engine_local_rows": local_rows,
             "engine_U_row0": np.asarray(model.U[0]).tolist(),
             "engine_n_users": len(model.user_vocab),
-            "engine_n_items": len(model.item_vocab)}
+            "engine_n_items": len(model.item_vocab),
+            "engine_degrade_rows": len(td2.columns.users),
+            "engine_degrade_U_row0": np.asarray(model2.U[0]).tolist()}
 
 
 def _phase_seqrec_tp(pid, nproc):

@@ -174,6 +174,13 @@ def test_two_process_sharded_als_matches_single_process(tmp_path):
     assert r0["engine_n_items"] == n_items
     np.testing.assert_allclose(r0["engine_U_row0"], r1["engine_U_row0"],
                                atol=1e-5)
+    # degrade path (backend without read_snapshot): replicated read,
+    # disjoint strided keep — each rating counted exactly once, so the
+    # model matches the sharded-read train up to f32 reduction order
+    assert (r0["engine_degrade_rows"] + r1["engine_degrade_rows"]
+            == len(ratings))
+    np.testing.assert_allclose(r0["engine_degrade_U_row0"],
+                               r0["engine_U_row0"], atol=1e-4)
 
     # -- seqrec with the MODEL axis spanning both processes: both hosts
     # extract the identical full (gathered) model, and the cross-host

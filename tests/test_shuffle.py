@@ -1,0 +1,37 @@
+"""parallel/shuffle.py single-process contracts (the multi-process
+behavior is exercised by tests/test_distributed.py across 2 real
+processes; these pin the degenerate paths and the payload encoding)."""
+
+import numpy as np
+
+from predictionio_tpu.parallel.shuffle import (
+    allgather_object, exchange_rows, global_vocab)
+
+
+def test_allgather_object_single_process():
+    assert allgather_object({"n": 3}) == [{"n": 3}]
+
+
+def test_global_vocab_single_process_sorted_unique():
+    v = global_vocab(np.array(["b", "a", "b", "c"], dtype=object))
+    assert v.tolist() == ["a", "b", "c"]
+
+
+def test_exchange_rows_single_process_is_stable_reorder():
+    dest = np.array([0, 0, 0, 0], np.int32)
+    payload = np.array([[1, 10], [2, 20], [3, 30], [4, 40]], np.int32)
+    out = exchange_rows(dest, payload)
+    np.testing.assert_array_equal(out, payload)     # order preserved
+
+    # non-trivial dest values on one process: stable sort by dest
+    dest = np.array([1, 0, 1, 0], np.int32)
+    out = exchange_rows(dest, payload)
+    np.testing.assert_array_equal(out[:, 0], [2, 4, 1, 3])
+
+
+def test_exchange_rows_roundtrips_float_bitcast():
+    vals = np.array([1.5, -0.25, 3e7, float("inf")], np.float32)
+    payload = np.stack(
+        [np.arange(4, dtype=np.int32), vals.view(np.int32)], axis=1)
+    out = exchange_rows(np.zeros(4, np.int32), payload)
+    np.testing.assert_array_equal(out[:, 1].copy().view(np.float32), vals)

@@ -740,17 +740,24 @@ def cfg_eval_sweep(jax, mesh, platform):
     fold_of = np.arange(nnz) % k_fold
 
     def sweep():
+        # fold data is rank-independent: build + commit each fold ONCE
+        # and let every rank train on the resident arrays (the
+        # CachedEvalRunner prefix-memoization semantics, SURVEY row 30 —
+        # the reference's FastEvalEngine re-reads per train instead)
+        fold_data = []
+        for f in range(k_fold):
+            tr = fold_of != f
+            fold_data.append(ALSData.build(
+                users[tr], items[tr], ratings[tr], nu, ni,
+                n_shards=1).put(mesh))
         best = (None, np.inf)
         for rank in ranks:
             params = ALSParams(rank=rank, num_iterations=iters, reg=REG,
                                chunk_size=16384)
             errs = []
             for f in range(k_fold):
-                tr = fold_of != f
-                te = ~tr
-                data = ALSData.build(users[tr], items[tr], ratings[tr],
-                                     nu, ni, n_shards=1)
-                U, V = train_als(mesh, data, params)
+                te = fold_of == f
+                U, V = train_als(mesh, fold_data[f], params)
                 errs.append(als_rmse(U, V, users[te], items[te],
                                      ratings[te]))
             mean_err = float(np.mean(errs))

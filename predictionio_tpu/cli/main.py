@@ -358,8 +358,13 @@ def train(variant, batch, skip_sanity_check, stop_after_read,
               help="App name for feedback events.")
 @click.option("--accesskey", default=None,
               help="Key required for /stop and /reload.")
+@click.option("--log-url", default=None,
+              help="POST serving errors to this URL "
+                   "(CreateServer remoteLog).")
+@click.option("--log-prefix", default="",
+              help="Prefix prepended to remote log payloads.")
 def deploy(variant, ip, port, engine_instance_id, feedback,
-           event_server_app, accesskey):
+           event_server_app, accesskey, log_url, log_prefix):
     """Deploy the latest COMPLETED instance (Console.scala:260,
     CreateServer.scala:109)."""
     from predictionio_tpu.server.query_server import run_query_server
@@ -386,7 +391,8 @@ def deploy(variant, ip, port, engine_instance_id, feedback,
     result, ctx = load_for_deploy(engine, instance)
     run_query_server(engine, result, instance, ctx, ip=ip, port=port,
                      feedback=feedback, feedback_app_name=event_server_app,
-                     access_key=accesskey)
+                     access_key=accesskey, log_url=log_url,
+                     log_prefix=log_prefix)
 
 
 @cli.command()

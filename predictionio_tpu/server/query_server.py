@@ -141,13 +141,19 @@ class QueryServer:
                  feedback: bool = False,
                  feedback_app_name: Optional[str] = None,
                  access_key: Optional[str] = None,
-                 plugin_context: Optional[PluginContext] = None):
+                 plugin_context: Optional[PluginContext] = None,
+                 log_url: Optional[str] = None,
+                 log_prefix: str = ""):
         self.engine = engine
         self.result = train_result
         self.instance = instance
         self.ctx = ctx
         self.feedback = feedback
         self.feedback_app_name = feedback_app_name
+        #: remote error sink (CreateServer.scala:435-446 remoteLog): on a
+        #: failed query, POST log_prefix + {"engineInstance", "message"}
+        self.log_url = log_url
+        self.log_prefix = log_prefix
         # resolve the feedback app once; a per-query metadata lookup would
         # sit on the hot path
         self._feedback_target = None
@@ -192,6 +198,27 @@ class QueryServer:
             "lastServingSec": self.last_serving_sec,
         })
 
+    async def _remote_log(self, message: str) -> None:
+        """POST a serving failure to the operator's log sink
+        (CreateServer.scala:435-446 remoteLog parity: prefix + JSON of
+        engine-instance metadata and the message; delivery failures are
+        logged locally and never propagate to the client response)."""
+        import aiohttp
+
+        payload = self.log_prefix + json.dumps({
+            "engineInstance": {"id": self.instance.id,
+                               "engineId": self.instance.engine_id,
+                               "engineVariant": self.instance.engine_variant},
+            "message": message})
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        self.log_url, data=payload,
+                        timeout=aiohttp.ClientTimeout(total=5)):
+                    pass
+        except Exception as e:
+            logger.error("Unable to send remote log: %s", e)
+
     # -- hot path (CreateServer.scala:484-605) -------------------------------
     async def handle_query(self, request):
         t0 = time.perf_counter()
@@ -211,6 +238,9 @@ class QueryServer:
                     None, self._predict, query)
         except Exception as e:
             logger.exception("query failed")
+            if self.log_url:
+                await self._remote_log(
+                    f"Query:\n{json.dumps(body)}\n\nError:\n{e!r}\n\n")
             return web.json_response({"message": str(e)}, status=400)
 
         pred_json = _to_jsonable(prediction)

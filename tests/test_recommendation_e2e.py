@@ -376,3 +376,49 @@ def instance_app_id():
     from predictionio_tpu.data.eventstore import resolve_app
 
     return resolve_app("MyApp1")[0]
+
+
+async def test_remote_error_log_posts_on_failure(app_with_ratings):
+    """--log-url parity (CreateServer.scala:435-446 remoteLog): a failed
+    query POSTs prefix + {engineInstance, message} to the sink; sink
+    failures never surface to the querying client."""
+    from aiohttp import web as _web
+
+    received = []
+
+    async def sink(request):
+        received.append(await request.text())
+        return _web.Response(text="ok")
+
+    sink_app = _web.Application()
+    sink_app.router.add_post("/log", sink)
+    sink_client = TestClient(TestServer(sink_app))
+    await sink_client.start_server()
+    sink_url = str(sink_client.make_url("/log"))
+
+    engine, instance = train_instance(app_with_ratings)
+    result, ctx = load_for_deploy(engine, instance)
+    server = create_query_server(engine, result, instance, ctx,
+                                 log_url=sink_url, log_prefix="PIO: ")
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/queries.json", json={"flavor": "?"})
+        assert resp.status == 400
+        assert len(received) == 1
+        assert received[0].startswith("PIO: ")
+        payload = json.loads(received[0][len("PIO: "):])
+        assert payload["engineInstance"]["id"] == instance.id
+        assert "flavor" in payload["message"]
+
+        # a healthy query never touches the sink
+        resp = await c.post("/queries.json", json={"user": "u1", "num": 2})
+        assert resp.status == 200
+        assert len(received) == 1
+
+        # a dead sink degrades to a local error, not a client failure
+        await sink_client.close()
+        resp = await c.post("/queries.json", json={"flavor": "?"})
+        assert resp.status == 400
+    finally:
+        await c.close()

@@ -652,14 +652,24 @@ def cfg_cooccurrence(jax, mesh, platform):
     flops = 2.0 * nu * ni * ni
     build_s = ph.get("incidence_build", 0.0)
     transfer_s = ph.get("incidence_transfer", 0.0)
+    if platform == "cpu":
+        # the single-device CPU fallback rebuilds + recomputes the
+        # IDENTICAL BLAS gemm + top-k the numpy baseline runs (no
+        # residency, no phase split — build_s/transfer_s are 0 here), so
+        # ~1x is structural parity, not a regression — the headroom is
+        # the MXU path
+        note = (f"{len(users)} distinct pairs, best of 3 full recomputes; "
+                f"CPU fallback = same BLAS as baseline (parity expected)")
+    else:
+        note = (f"{len(users)} distinct pairs; steady-state counts on "
+                f"a resident incidence matrix, best of 3 (cold "
+                f"build+upload+compile reported separately)")
     return {"elapsed_s": round(elapsed, 4),
             "build_s": round(build_s, 3),
             "transfer_s": round(transfer_s, 3),
             "compile_s": round(cold - elapsed - build_s - transfer_s, 3),
             "model_flops": flops,
-            "note": f"{len(users)} distinct pairs; steady-state counts on "
-                    f"a resident incidence matrix, best of 3 (cold "
-                    f"build+upload+compile reported separately)"}
+            "note": note}
 
 
 def cfg_naive_bayes(jax, mesh, platform):

@@ -31,6 +31,25 @@ EVENT_SCHEMA = pa.schema([
 ])
 
 
+def rows_to_event_table(rows) -> pa.Table:
+    """SQL result rows (9 columns in EVENT_SCHEMA order: id, event,
+    entityType, entityId, targetEntityType, targetEntityId, properties,
+    eventTime, creationTime) -> the shared columnar layout. One builder
+    for every SQL backend's `find_columnar` so the schema can never
+    drift between them."""
+    if not rows:
+        return pa.table({n: [] for n in EVENT_SCHEMA.names},
+                        schema=EVENT_SCHEMA)
+    c = list(zip(*rows))
+    return pa.table({
+        "event_id": c[0], "event": c[1], "entity_type": c[2],
+        "entity_id": c[3], "target_entity_type": c[4],
+        "target_entity_id": c[5],
+        "properties": [p if p else None for p in c[6]],
+        "event_time_ms": c[7], "creation_time_ms": c[8],
+    }, schema=EVENT_SCHEMA)
+
+
 def events_to_table(events: Iterable[Event]) -> pa.Table:
     cols = {name: [] for name in EVENT_SCHEMA.names}
     for e in events:

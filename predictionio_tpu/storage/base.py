@@ -392,4 +392,18 @@ class EventStore(abc.ABC):
         return events_to_table(self.find(app_id, channel_id, **filters))
 
 
+def shard_window(lo_all: int, hi_all: int, shard) -> "tuple[int, int]":
+    """One of `count` near-equal [lo, hi) sub-windows of a numeric
+    snapshot range — the shared partition arithmetic for range-sharded
+    backends (sqlite rowids, postgres eventTimes). The last window clamps
+    to the snapshot end so values arriving after the snapshot can never
+    leak into it."""
+    idx, count = shard[0], shard[1]
+    if not (0 <= idx < count):
+        raise StorageError(f"bad shard {shard}")
+    span = -(-(hi_all - lo_all) // count)
+    return (lo_all + idx * span,
+            min(lo_all + (idx + 1) * span, hi_all))
+
+
 _SPECIAL = ("$set", "$unset", "$delete")

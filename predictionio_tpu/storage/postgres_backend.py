@@ -203,10 +203,8 @@ class PostgresEvents(base.EventStore):
         self.client.commit()
         return cur.rowcount > 0
 
-    def find(
+    def _where(
         self,
-        app_id: int,
-        channel_id: Optional[int] = None,
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
         entity_type: Optional[str] = None,
@@ -214,10 +212,7 @@ class PostgresEvents(base.EventStore):
         event_names: Optional[Sequence[str]] = None,
         target_entity_type=UNFILTERED,
         target_entity_id=UNFILTERED,
-        limit: Optional[int] = None,
-        reversed_order: bool = False,
-    ) -> Iterator[Event]:
-        name = event_table_name(app_id, channel_id)
+    ):
         where, params = ["TRUE"], []
         if start_time is not None:
             where.append("eventTime >= %s")
@@ -247,6 +242,19 @@ class PostgresEvents(base.EventStore):
             else:
                 where.append("targetEntityId = %s")
                 params.append(target_entity_id)
+        return where, params
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+        **filters,
+    ) -> Iterator[Event]:
+        name = event_table_name(app_id, channel_id)
+        where, params = self._where(**filters)
         order = "DESC" if reversed_order else "ASC"
         sql = (f"SELECT {_EVENT_COLS} FROM {name} "
                f"WHERE {' AND '.join(where)} ORDER BY eventTime {order}")
@@ -255,6 +263,52 @@ class PostgresEvents(base.EventStore):
             params.append(limit)
         for row in self.client.execute(sql, params):
             yield _row_to_event(row)
+
+    def read_snapshot(self, app_id: int,
+                      channel_id: Optional[int] = None):
+        """Partitioned-read window [lo_ms, hi_ms) over eventTime — the
+        reference's own partitioning axis (JDBCPEvents.scala:89-101
+        builds numeric range partitions over the time column). Unlike
+        sqlite's rowid fence, a row ingested after the snapshot whose
+        eventTime falls inside the window WILL be seen (same property as
+        the reference); training reads assume an effectively static
+        store."""
+        name = event_table_name(app_id, channel_id)
+        row = self.client.execute(
+            f"SELECT MIN(eventTime), MAX(eventTime) FROM {name}").fetchone()
+        return (row[0] or 0), (row[1] or 0) + 1
+
+    def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
+                      *, ordered: bool = True, limit: Optional[int] = None,
+                      reversed_order: bool = False, shard=None, **filters):
+        """Columnar scan -> pyarrow.Table (the JDBCPEvents.scala:35
+        training read): SQL straight into columnar buffers, optional
+        ``shard=(index, count[, snapshot])`` restricting to one eventTime
+        range partition (JDBCPEvents.scala:89-101)."""
+        from predictionio_tpu.data.columnar import rows_to_event_table
+        from predictionio_tpu.storage.base import shard_window
+
+        name = event_table_name(app_id, channel_id)
+        where, params = self._where(**filters)
+        if shard is not None:
+            if len(shard) > 2 and shard[2] is not None:
+                lo_all, hi_all = shard[2]
+            else:
+                lo_all, hi_all = self.read_snapshot(app_id, channel_id)
+            lo, hi = shard_window(lo_all, hi_all, shard)
+            where.append("eventTime >= %s AND eventTime < %s")
+            params.extend([lo, hi])
+        if reversed_order or limit is not None:
+            ordered = True
+        sql = (f"SELECT id, event, entityType, entityId, targetEntityType, "
+               f"targetEntityId, properties, eventTime, creationTime "
+               f"FROM {name} WHERE {' AND '.join(where)}")
+        if ordered:
+            sql += f" ORDER BY eventTime {'DESC' if reversed_order else 'ASC'}"
+        if limit is not None and limit >= 0:
+            sql += " LIMIT %s"
+            params.append(limit)
+        return rows_to_event_table(self.client.execute(sql, params).fetchall())
 
 
 def _row_to_event(row) -> Event:

@@ -222,9 +222,6 @@ class SqliteEvents(base.EventStore):
         name = event_table_name(app_id, channel_id)
         where, params = ["1=1"], []
         if shard is not None:
-            idx, count = shard[0], shard[1]
-            if not (0 <= idx < count):
-                raise StorageError(f"bad shard {shard}")
             if len(shard) > 2 and shard[2] is not None:
                 # pre-agreed snapshot window: multi-process readers MUST
                 # share one (read_snapshot + a collective broadcast) or
@@ -233,13 +230,9 @@ class SqliteEvents(base.EventStore):
                 lo_all, hi_all = shard[2]
             else:
                 lo_all, hi_all = self.read_snapshot(app_id, channel_id)
-            span = -(-(hi_all - lo_all) // count)
+            lo, hi = base.shard_window(lo_all, hi_all, shard)
             where.append("rowid >= ? AND rowid < ?")
-            # clamp to the snapshot's end: the last partition's arithmetic
-            # bound can exceed hi_all and would leak rows ingested after
-            # the snapshot into this read
-            params.extend([lo_all + idx * span,
-                           min(lo_all + (idx + 1) * span, hi_all)])
+            params.extend([lo, hi])
         if start_time is not None:
             where.append("eventTime >= ?")
             params.append(_to_ms(start_time))
@@ -313,9 +306,7 @@ class SqliteEvents(base.EventStore):
         arrays). ``ordered=False`` (training reads) additionally drops
         the global time sort. ``reversed_order``/``limit`` semantics
         require the sort, so they force it back on."""
-        import pyarrow as pa
-
-        from predictionio_tpu.data.columnar import EVENT_SCHEMA
+        from predictionio_tpu.data.columnar import rows_to_event_table
 
         if filters.get("reversed_order") or filters.get("limit") is not None:
             ordered = True
@@ -328,17 +319,7 @@ class SqliteEvents(base.EventStore):
         except sqlite3.OperationalError as ex:
             raise StorageError(
                 f"cannot read app {app_id} channel {channel_id}: {ex}") from ex
-        if not rows:
-            return pa.table({n: [] for n in EVENT_SCHEMA.names},
-                            schema=EVENT_SCHEMA)
-        c = list(zip(*rows))
-        return pa.table({
-            "event_id": c[0], "event": c[1], "entity_type": c[2],
-            "entity_id": c[3], "target_entity_type": c[4],
-            "target_entity_id": c[5],
-            "properties": [p if p else None for p in c[6]],
-            "event_time_ms": c[7], "creation_time_ms": c[8],
-        }, schema=EVENT_SCHEMA)
+        return rows_to_event_table(rows)
 
 
 def _row_to_event(row) -> Event:

@@ -487,10 +487,12 @@ def test_fs_models_rejects_traversal_ids(tmp_path):
 
 # -- partitioned (sharded) reads: P2, JDBCPEvents.scala:89-101 analog --------
 
-@pytest.mark.parametrize("kind", ["sqlite", "parquet"])
+@pytest.mark.parametrize("kind", ["sqlite", "parquet", "postgres"])
 def test_sharded_read_partitions_exactly(tmp_path, kind):
     if kind == "sqlite":
         s = SqliteEvents(SqliteClient(str(tmp_path / "sh.db")))
+    elif kind == "postgres":
+        s = _postgres_store_or_skip()
     else:
         s = ParquetEvents(ParquetEventsClient(str(tmp_path / "sh_pq")))
     s.init_channel(1)

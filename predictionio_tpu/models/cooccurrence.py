@@ -6,23 +6,27 @@ src/main/scala/CooccurrenceAlgorithm.scala:71-105): distinct (user, item)
 pairs -> per-item-pair counts -> top-N per item.
 
 Design: counting cooccurrences is C = A^T A for the binary user x item
-interaction matrix. When the dense A fits a memory budget:
+interaction matrix. When A fits the device budget:
 
-* A is scattered on the HOST (numpy fancy indexing — microseconds; the
-  r2 version used XLA `.at[u,i].set` and lost to numpy 0.59x because a
-  big one-hot scatter is a terrible XLA op) and shipped as bf16 (0 and 1
-  are exact in bf16; products accumulate in f32, exact below 2^24).
-* C's ROW BLOCKS are sharded over the mesh's "data" axis via shard_map:
-  device d computes C[block_d, :] = A[:, block_d]^T @ A as one bf16 MXU
-  matmul and immediately reduces it to a per-row top-N — the full
-  [n_items, n_items] count matrix never materializes in one device's
-  HBM, and the only collective is the all-gather of the [n_items, k]
-  result (SURVEY.md §2.9 P1/P4: the Spark self-join becomes a sharded
-  matmul + top-k).
+* A is scattered on the HOST as uint8 (numpy fancy indexing —
+  microseconds; the r2 version used XLA `.at[u,i].set` and lost to
+  numpy 0.59x because a big one-hot scatter is a terrible XLA op),
+  shipped once and kept device-resident (ops/device_cache), and widened
+  on device: bf16 on the MXU (0/1 exact, f32 accumulation, exact below
+  2^24), f32 on CPU.
+* C's ROW BLOCKS are sharded over the mesh's first axis via shard_map:
+  device d assembles full-width A with ONE on-device all_gather (riding
+  ICI/DCN — this also serves multi-process meshes), then computes its
+  block C[block_d, :] = A[:, block_d]^T @ A in 512-row SLABS, reducing
+  each slab to its per-row top-N immediately. Neither the full
+  [n_items, n_items] count matrix nor even one device's whole block
+  ever materializes — the item-space ceiling is O(nu * ni) HBM, not
+  O(ni^2) (SURVEY.md §2.9 P1/P4: the Spark self-join becomes a sharded
+  slab matmul + top-k).
 
-Larger item spaces fall back to vectorized host counting over sorted
-per-user pair enumeration (the same work the Spark join materializes,
-without the shuffle).
+Item spaces past the HBM budget fall back to vectorized host counting
+over sorted per-user pair enumeration (the same work the Spark join
+materializes, without the shuffle).
 """
 
 from __future__ import annotations
@@ -36,7 +40,16 @@ import numpy as np
 from predictionio_tpu.data.bimap import vocab_index
 
 #: max dense A entries before falling back to host counting (f32 ~2GB)
+#: on CPU / unknown backends
 DENSE_BUDGET = 500_000_000
+#: per-TPU-chip HBM byte budget for the slabbed kernel (16GB chips,
+#: leaving headroom for XLA workspace). The dominant term is the
+#: REPLICATED bf16 all-gather of A on every chip — it does not shard,
+#: so the budget must not scale with device count. Covers similarproduct
+#: at the ML-20M shape (138k x 27k: ~11.3GB/chip) on one v5e.
+DEVICE_HBM_BUDGET = 12_000_000_000
+#: kernel slab height (rows of the count block materialized at once)
+KERNEL_SLAB = 512
 
 
 def distinct_pairs(user_idx: np.ndarray, item_idx: np.ndarray
@@ -131,6 +144,14 @@ def _sharded_topn_fn(mesh, axis: str, n_dev: int, blk: int, ni_pad: int,
         # accumulate), f32 on CPU where XLA emulates bf16 matmuls slowly
         cdt = (jnp.bfloat16 if jax.default_backend() in ("tpu", "axon")
                else jnp.float32)
+        # row-SLAB the count block: the full [blk, ni_pad] C block would
+        # put an O(n_items^2 / n_dev) buffer in HBM (2.9GB at ML-20M's
+        # 27k items on one chip); slabs of 512 rows reduce the count to
+        # top-k immediately, so HBM holds only A and a [512, ni_pad]
+        # slab — the item-space ceiling becomes O(nu * ni), not O(ni^2)
+        slab = min(KERNEL_SLAB, blk)
+        n_slabs = -(-blk // slab)
+        blk_pad = n_slabs * slab
 
         def block(a_cols):
             # a_cols [nu, blk]: this device's item column block; the full
@@ -139,14 +160,23 @@ def _sharded_topn_fn(mesh, axis: str, n_dev: int, blk: int, ni_pad: int,
             # makes the same kernel serve multi-process meshes
             a_full = jax.lax.all_gather(
                 a_cols.astype(cdt), axis, axis=1, tiled=True)
-            c = jnp.dot(a_cols.T.astype(cdt), a_full,
-                        preferred_element_type=jnp.float32)  # [blk, ni_pad]
             row0 = jax.lax.axis_index(axis) * blk
-            rows = row0 + jnp.arange(blk)[:, None]
             cols = jnp.arange(ni_pad)[None, :]
-            c = jnp.where(rows == cols, 0.0, c)              # zero diagonal
-            vals, idx = jax.lax.top_k(c, k)
-            return vals[None], idx[None]
+            a_pad = jnp.pad(a_cols, ((0, 0), (0, blk_pad - blk)))
+
+            def one_slab(j):
+                sl = jax.lax.dynamic_slice(
+                    a_pad, (0, j * slab), (a_pad.shape[0], slab))
+                c = jnp.dot(sl.T.astype(cdt), a_full,
+                            preferred_element_type=jnp.float32)
+                rows = row0 + j * slab + jnp.arange(slab)[:, None]
+                c = jnp.where(rows == cols, 0.0, c)      # zero diagonal
+                # padded slab rows (rows >= row0+blk) only ever produce
+                # zeros: their a_pad columns are zero
+                return jax.lax.top_k(c, k)
+            vals, idx = jax.lax.map(one_slab, jnp.arange(n_slabs))
+            return (vals.reshape(1, blk_pad, k)[:, :blk],
+                    idx.reshape(1, blk_pad, k)[:, :blk])
 
         sharded = shard_map(
             block, mesh=mesh,
@@ -263,13 +293,25 @@ def train_cooccurrence(user_idx: np.ndarray, item_idx: np.ndarray,
     user_idx, item_idx = distinct_pairs(user_idx, item_idx)
     # budget check BEFORE any jax backend init (jax.devices() claims the
     # chip — pointless and potentially minutes-slow over a tunnel when
-    # the host fallback is going to run anyway). The padded width is what
-    # actually gets allocated/replicated: [n_users, ni_pad] at 128-lane
-    # blocks per FIRST-axis shard (shard_map shards that axis only), plus
-    # the [n_items, n_items] count matrix.
+    # the host fallback is going to run anyway). The slabbed kernel never
+    # materializes the [n_items, n_items] count matrix; its PER-CHIP
+    # working set is the uint8 A shard + the replicated bf16 all-gather
+    # of full-width A (which does NOT shrink with more chips) + one
+    # [slab, ni_pad] f32 count block. With a mesh already claimed we can
+    # see the backend; the CPU/default budget stays conservative.
     n_shards = int(mesh.shape[mesh.axis_names[0]]) if mesh is not None else 1
     ni_pad = -(-n_items // (128 * n_shards)) * 128 * n_shards
-    if max(n_users * ni_pad, n_items * n_items) <= DENSE_BUDGET:
+    fits = n_users * ni_pad <= DENSE_BUDGET
+    if not fits and mesh is not None:
+        import jax
+
+        if jax.default_backend() in ("tpu", "axon"):
+            n_dev = int(np.prod(mesh.devices.shape))
+            per_chip = (n_users * ni_pad // n_dev       # uint8 shard
+                        + 2 * n_users * ni_pad          # bf16 gather
+                        + 4 * KERNEL_SLAB * ni_pad)     # f32 slab block
+            fits = per_chip <= DEVICE_HBM_BUDGET
+    if fits:
         if mesh is None:
             import jax
             from jax.sharding import Mesh

@@ -330,6 +330,30 @@ def test_cooccurrence_multi_axis_mesh_matches_single(mesh8):
     np.testing.assert_array_equal(v1, v42)
 
 
+def test_cooccurrence_multi_slab_matches_reference(mesh8):
+    # item space large enough that each device's column block spans
+    # SEVERAL 512-row slabs (the O(ni^2)-free kernel path, r5): results
+    # must equal the dense numpy counts
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.models.cooccurrence import cooccurrence_topn
+
+    mesh2 = Mesh(np.asarray(jax.devices()[:2]), axis_names=("data",))
+    rng = np.random.default_rng(8)
+    nu, ni = 180, 1400              # blk = 768 -> 2 slabs per device
+    u = rng.integers(0, nu, 6000).astype(np.int32)
+    i = rng.integers(0, ni, 6000).astype(np.int32)
+    du, di = distinct_pairs(u, i)
+    vals, idx = cooccurrence_topn(mesh2, du, di, nu, ni, 5)
+    a = np.zeros((nu, ni), np.float32)
+    a[du, di] = 1.0
+    c = a.T @ a
+    np.fill_diagonal(c, 0.0)
+    ref = -np.sort(-c, axis=1)[:, :5]
+    np.testing.assert_array_equal(vals, ref.astype(vals.dtype))
+
+
 def test_forest_padded_trees_sliced_off(mesh8):
     # num_trees not a multiple of the shard count: the fit pads, but the
     # MODEL must keep exactly num_trees and match the single-device run

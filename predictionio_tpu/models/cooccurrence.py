@@ -148,8 +148,10 @@ def _sharded_topn_fn(mesh, axis: str, n_dev: int, blk: int, ni_pad: int,
         # put an O(n_items^2 / n_dev) buffer in HBM (2.9GB at ML-20M's
         # 27k items on one chip); slabs of 512 rows reduce the count to
         # top-k immediately, so HBM holds only A and a [512, ni_pad]
-        # slab — the item-space ceiling becomes O(nu * ni), not O(ni^2)
-        slab = min(KERNEL_SLAB, blk)
+        # slab — the item-space ceiling becomes O(nu * ni), not O(ni^2).
+        # Small blocks (f32 C block <= 256MB, e.g. the ML-1M shape) keep
+        # the single-matmul fast path: one big MXU dispatch, no loop.
+        slab = blk if blk * ni_pad * 4 <= (1 << 28) else min(KERNEL_SLAB, blk)
         n_slabs = -(-blk // slab)
         blk_pad = n_slabs * slab
 

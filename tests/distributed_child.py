@@ -166,6 +166,24 @@ def _phase_seqrec_tp(pid, nproc):
             "seqrec_emb_shape": list(emb.shape)}
 
 
+def _phase_nb(mesh, pid, nproc):
+    """Classification across processes: the sharded count path's psum
+    spans both hosts (X crosses DEVICE_MIN_SIZE organically — no
+    monkey-patching)."""
+    import numpy as np
+
+    from predictionio_tpu.models import naive_bayes
+    from predictionio_tpu.models.naive_bayes import train_multinomial_nb
+
+    rng = np.random.default_rng(31)
+    X = rng.poisson(1.0, size=(140_000, 8)).astype(np.float32)
+    y = np.where(rng.random(len(X)) < 0.5, "a", "b")
+    assert X.size >= naive_bayes.DEVICE_MIN_SIZE
+    model = train_multinomial_nb(X, y, mesh=mesh)
+    return {"nb_log_prob_sum": float(np.abs(model.log_prob).sum()),
+            "nb_log_prior": model.log_prior.tolist()}
+
+
 def _phase_cooc(mesh, pid, nproc):
     """Sharded cooccurrence from per-process pair shards: all_to_all
     re-key, local incidence block, matmul with on-device gather."""
@@ -256,6 +274,7 @@ def main() -> None:
         result.update(_phase_engine_train(mesh, pid, nproc, db_path))
     result.update(_phase_seqrec_tp(pid, nproc))
     result.update(_phase_cooc(mesh, pid, nproc))
+    result.update(_phase_nb(mesh, pid, nproc))
 
     print("RESULT " + json.dumps(result), flush=True)
 

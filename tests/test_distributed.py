@@ -207,3 +207,17 @@ def test_two_process_sharded_als_matches_single_process(tmp_path):
     np.testing.assert_allclose(np.asarray(cv[0], np.float64).tolist(),
                                r0["cooc_vals_row0"])
     assert r0["cooc_vals_sum"] == r1["cooc_vals_sum"]
+
+    # -- classification (NB) across processes: the psum'd counts match a
+    # single-process train of the same data (organic DEVICE_MIN_SIZE
+    # crossing — the r4 "classification has no multi-process execution"
+    # gap)
+    from predictionio_tpu.models.naive_bayes import train_multinomial_nb
+    rngn = np.random.default_rng(31)
+    Xn = rngn.poisson(1.0, size=(140_000, 8)).astype(np.float32)
+    yn = np.where(rngn.random(len(Xn)) < 0.5, "a", "b")
+    mn = train_multinomial_nb(Xn, yn)
+    np.testing.assert_allclose(float(np.abs(mn.log_prob).sum()),
+                               r0["nb_log_prob_sum"], rtol=1e-6)
+    np.testing.assert_allclose(mn.log_prior, r0["nb_log_prior"], rtol=1e-9)
+    assert r0["nb_log_prob_sum"] == r1["nb_log_prob_sum"]

@@ -76,7 +76,17 @@ class DataSourceParams(Params):
 
 class SessionDataSource(DataSource):
     """Groups user->item events into per-user sessions ordered by
-    eventTime (the sequence analog of DataSource.scala:39's event read)."""
+    eventTime (the sequence analog of DataSource.scala:39's event read).
+
+    Multi-process note: this read is deliberately UNSHARDED — sessions
+    must stay whole, and range/fragment shards (`find_columnar(shard=)`)
+    would split a user's events across processes. Every host reads the
+    full session set (they are small next to the model) and the train
+    step shards the BATCH over the mesh's "data" axis; a partitioned
+    session loader would need an exchange keyed by user (the
+    parallel/shuffle.exchange_rows pattern ALS uses for segments) plus
+    per-process batch assembly, which the replicated design makes
+    unnecessary at current scales."""
 
     params_class = DataSourceParams
 

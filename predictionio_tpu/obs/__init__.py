@@ -1,0 +1,61 @@
+"""predictionio_tpu.obs — unified metrics + request tracing.
+
+See OBSERVABILITY.md at the repo root for metric names, label
+conventions, scrape endpoints, and the slow-request log format.
+"""
+
+from predictionio_tpu.obs.jax_stats import compile_counter, register_jax_metrics
+from predictionio_tpu.obs.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    PROMETHEUS_CONTENT_TYPE,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    exponential_buckets,
+    render_json,
+    render_prometheus,
+)
+from predictionio_tpu.obs.tracing import (
+    REQUEST_ID_HEADER,
+    Trace,
+    current_request_id,
+    current_trace,
+    new_request_id,
+    span,
+)
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "PROMETHEUS_CONTENT_TYPE",
+    "REQUEST_ID_HEADER",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Trace",
+    "compile_counter",
+    "current_request_id",
+    "current_trace",
+    "default_registry",
+    "exponential_buckets",
+    "new_request_id",
+    "register_jax_metrics",
+    "render_json",
+    "render_prometheus",
+    "span",
+]
+
+
+def observability_middleware(*args, **kwargs):
+    """Lazy re-export: keeps `import predictionio_tpu.obs` aiohttp-free."""
+    from predictionio_tpu.obs.middleware import observability_middleware as mw
+
+    return mw(*args, **kwargs)
+
+
+def add_metrics_routes(*args, **kwargs):
+    from predictionio_tpu.obs.middleware import add_metrics_routes as add
+
+    return add(*args, **kwargs)

@@ -1,0 +1,77 @@
+"""JAX device metrics: compile counts + live device-array footprint.
+
+Callback gauges evaluated at scrape time, deliberately gated on jax
+already being imported — a /metrics scrape on a process that never
+touched jax (bare event server) must not trigger backend init.
+
+``pio_jax_compile_total`` is incremented by ``ops.fn_cache`` whenever a
+mesh-closed executable is (re)built, so a climbing compile count on a
+serving box flags a retrace leak (the exact failure fn_cache exists to
+prevent).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
+
+COMPILE_COUNTER = "pio_jax_compile_total"
+
+
+def compile_counter(registry: MetricsRegistry = None):
+    """The (family-labelled) compiled-executable-build counter."""
+    return (registry or default_registry()).counter(
+        COMPILE_COUNTER,
+        "Compiled executables built per fn_cache family",
+        labelnames=("family",))
+
+
+def _jax():
+    """jax iff something else already imported it; never init from here."""
+    return sys.modules.get("jax")
+
+
+def _device_count() -> float:
+    jax = _jax()
+    if jax is None:
+        return 0.0
+    try:
+        return float(len(jax.devices()))
+    except Exception:
+        return 0.0
+
+
+def _live_buffer_bytes() -> float:
+    jax = _jax()
+    if jax is None:
+        return 0.0
+    try:
+        return float(sum(int(a.nbytes) for a in jax.live_arrays()))
+    except Exception:
+        return 0.0
+
+
+def _live_buffer_count() -> float:
+    jax = _jax()
+    if jax is None:
+        return 0.0
+    try:
+        return float(len(jax.live_arrays()))
+    except Exception:
+        return 0.0
+
+
+def register_jax_metrics(registry: MetricsRegistry = None) -> MetricsRegistry:
+    """Idempotently register the device gauges (+ the compile counter so
+    it renders even before the first build)."""
+    reg = registry or default_registry()
+    compile_counter(reg)
+    reg.gauge_callback("pio_jax_device_count",
+                       "Visible JAX devices", _device_count)
+    reg.gauge_callback("pio_jax_live_buffer_bytes",
+                       "Bytes held by live device arrays",
+                       _live_buffer_bytes)
+    reg.gauge_callback("pio_jax_live_buffer_count",
+                       "Number of live device arrays", _live_buffer_count)
+    return reg

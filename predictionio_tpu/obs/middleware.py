@@ -1,0 +1,128 @@
+"""aiohttp observability: request middleware + /metrics endpoints.
+
+``observability_middleware(registry, service)`` gives every request a
+request ID (honouring an incoming ``X-Request-ID``), opens a trace for
+the ``span()`` API, times the handler into
+``pio_http_request_duration_seconds{service,method,handler,status}``,
+tracks in-flight requests, and emits a structured slow-request log line
+when the wall time crosses the threshold (``PIO_SLOW_REQUEST_SECONDS``,
+default 1.0 s).
+
+``add_metrics_routes(app, *registries)`` mounts ``GET /metrics``
+(Prometheus text exposition 0.0.4) and ``GET /metrics.json`` rendering
+the given registries merged — by convention the server's own registry
+first, then :func:`default_registry` so workflow/JAX process metrics
+ride along on every scrape.  The endpoints are deliberately
+unauthenticated (scrapers hold no access keys); they expose aggregate
+counts only.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from aiohttp import web
+
+from predictionio_tpu.obs.registry import (
+    PROMETHEUS_CONTENT_TYPE, MetricsRegistry, default_registry,
+    render_json, render_prometheus,
+)
+from predictionio_tpu.obs.tracing import (
+    REQUEST_ID_HEADER, log_slow_request, new_request_id, reset_trace,
+    span_histogram, start_trace,
+)
+
+logger = logging.getLogger("pio.obs")
+
+DEFAULT_SLOW_REQUEST_SECONDS = 1.0
+
+
+def slow_request_threshold() -> float:
+    try:
+        return float(os.environ.get("PIO_SLOW_REQUEST_SECONDS",
+                                    DEFAULT_SLOW_REQUEST_SECONDS))
+    except ValueError:
+        return DEFAULT_SLOW_REQUEST_SECONDS
+
+
+def _handler_label(request: web.Request) -> str:
+    """Route template, not raw path — bounds label cardinality."""
+    try:
+        resource = request.match_info.route.resource
+        if resource is not None:
+            return resource.canonical
+    except Exception:
+        pass
+    return "__unmatched__"
+
+
+def observability_middleware(registry: MetricsRegistry, service: str,
+                             slow_threshold_s: float = None):
+    if slow_threshold_s is None:
+        slow_threshold_s = slow_request_threshold()
+    duration = registry.histogram(
+        "pio_http_request_duration_seconds",
+        "HTTP request wall time by service/method/handler/status",
+        labelnames=("service", "method", "handler", "status"))
+    in_flight = registry.gauge(
+        "pio_http_requests_in_flight",
+        "Requests currently being handled", labelnames=("service",))
+    spans = span_histogram(registry)
+
+    @web.middleware
+    async def middleware(request, handler):
+        request_id = request.headers.get(REQUEST_ID_HEADER) or new_request_id()
+        tokens, trace = start_trace(request_id, registry, spans)
+        in_flight.inc(service=service)
+        t0 = time.perf_counter()
+        status = 500
+        try:
+            response = await handler(request)
+            status = response.status
+            response.headers[REQUEST_ID_HEADER] = request_id
+            return response
+        except web.HTTPException as exc:
+            status = exc.status
+            exc.headers[REQUEST_ID_HEADER] = request_id
+            raise
+        except Exception:
+            # aiohttp's stock 500 carries no headers — answer ourselves so
+            # crash responses still carry the correlation id
+            logger.exception("unhandled error in %s %s %s",
+                             service, request.method, request.path)
+            return web.json_response(
+                {"message": "Internal Server Error"}, status=500,
+                headers={REQUEST_ID_HEADER: request_id})
+        finally:
+            in_flight.dec(service=service)
+            dt = time.perf_counter() - t0
+            duration.observe(dt, service=service, method=request.method,
+                             handler=_handler_label(request),
+                             status=str(status))
+            if dt >= slow_threshold_s:
+                log_slow_request(service, request.method, request.path,
+                                 status, dt, trace)
+            reset_trace(tokens)
+
+    return middleware
+
+
+METRICS_PATHS = ("/metrics", "/metrics.json")
+
+
+def add_metrics_routes(app: web.Application,
+                       *registries: MetricsRegistry) -> None:
+    regs = tuple(registries) or (default_registry(),)
+
+    async def handle_metrics(request):
+        text = render_prometheus(regs)
+        return web.Response(body=text.encode("utf-8"),
+                            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE})
+
+    async def handle_metrics_json(request):
+        return web.json_response(render_json(regs))
+
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/metrics.json", handle_metrics_json)

@@ -1,0 +1,435 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+The reference PredictionIO exposes nothing beyond Spark's UI and the
+per-app ingest counters in Stats.scala; the rebuild's north star (heavy
+traffic, hot paths as fast as the hardware allows) needs first-class
+latency/throughput/device metrics before further perf work — the same
+instrument-then-optimize discipline ALX and MLlib used to find their
+TPU/Spark bottlenecks.
+
+Three metric kinds, all label-aware and thread-safe:
+
+  * :class:`Counter`   — monotonically increasing totals
+  * :class:`Gauge`     — point-in-time values, optionally callback-backed
+                         (evaluated lazily at scrape time)
+  * :class:`Histogram` — bucketed observations with exponential latency
+                         buckets by default, plus p50/p95/p99 estimation
+
+A :class:`MetricsRegistry` owns metrics by name (get-or-create, so any
+module can reach "its" counter without plumbing objects through every
+signature) and renders them as Prometheus text exposition format 0.0.4
+or as JSON.  Servers create one registry per instance (test isolation);
+workflow/device metrics live on the process-global ``default_registry()``
+and both are merged at the ``/metrics`` endpoints.
+
+Dependency-free by design: nothing here imports aiohttp or jax, so
+storage/CLI paths can publish metrics without pulling server deps.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: 0.5 ms .. ~16 s, doubling — covers a jitted matvec through a cold
+#: XLA compile on the serving path.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = tuple(
+    0.0005 * 2.0 ** i for i in range(16))
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def exponential_buckets(start: float, factor: float, count: int
+                        ) -> Tuple[float, ...]:
+    """`count` bucket upper bounds growing geometrically from `start`."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("need start > 0, factor > 1, count >= 1")
+    return tuple(start * factor ** i for i in range(count))
+
+
+def _escape_label_value(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _format_value(value: float) -> str:
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    f = float(value)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _format_labels(labelnames: Sequence[str], labelvalues: Sequence[str],
+                   extra: Sequence[Tuple[str, str]] = ()) -> str:
+    pairs = [(n, v) for n, v in zip(labelnames, labelvalues)]
+    pairs.extend(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label_value(str(v))}"' for n, v in pairs)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def signature(self) -> Tuple[str, Tuple[str, ...]]:
+        return (self.kind, self.labelnames)
+
+    # subclasses implement: samples(), render(lines)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def contains(self, **labels) -> bool:
+        key = self._key(labels)
+        with self._lock:
+            return key in self._values
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._values)
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [(dict(zip(self.labelnames, k)), v) for k, v in items]
+
+    def render(self, lines: List[str]) -> None:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.labelnames:
+            items = [((), 0.0)]
+        for key, value in items:
+            lines.append(self.name
+                         + _format_labels(self.labelnames, key)
+                         + " " + _format_value(value))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._fn: Optional[Callable] = None
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def set_function(self, fn: Callable) -> None:
+        """Lazy gauge: `fn()` is evaluated at scrape time and must return
+        a number, or an iterable of (labels_dict, number) when the gauge
+        has labelnames."""
+        self._fn = fn
+
+    def value(self, **labels) -> float:
+        for sample_labels, v in self.samples():
+            if sample_labels == {k: str(v_) for k, v_ in labels.items()}:
+                return v
+        return 0.0
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        fn = self._fn
+        if fn is not None:
+            try:
+                out = fn()
+            except Exception:
+                return []
+            if isinstance(out, (int, float)):
+                return [({}, float(out))]
+            return [(dict(labels), float(v)) for labels, v in out]
+        with self._lock:
+            items = sorted(self._values.items())
+        return [(dict(zip(self.labelnames, k)), v) for k, v in items]
+
+    def render(self, lines: List[str]) -> None:
+        samples = self.samples()
+        if not samples and not self.labelnames and self._fn is None:
+            samples = [({}, 0.0)]
+        for labels, value in samples:
+            names = tuple(labels)
+            values = tuple(labels[n] for n in names)
+            lines.append(self.name + _format_labels(names, values)
+                         + " " + _format_value(value))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        super().__init__(name, help, labelnames)
+        finite = sorted({float(b) for b in buckets if b != math.inf})
+        if not finite:
+            raise ValueError("histogram needs at least one finite bucket")
+        self.buckets = tuple(finite)  # +Inf is implicit
+        #: key -> [per-bucket counts..., +Inf count] plus running sum
+        self._counts: Dict[Tuple[str, ...], List[float]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0.0] * (len(self.buckets) + 1)
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    # -- accessors (serving-stats endpoints read these) ----------------------
+    def count(self, **labels) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return float(sum(self._counts.get(key, ())))
+
+    def total_count(self) -> float:
+        with self._lock:
+            return float(sum(sum(c) for c in self._counts.values()))
+
+    def sum_(self, **labels) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._sums.get(key, 0.0)
+
+    def total_sum(self) -> float:
+        with self._lock:
+            return float(sum(self._sums.values()))
+
+    def quantile(self, q: float, **labels) -> float:
+        """Estimate the q-quantile (0 < q < 1) by linear interpolation
+        within the bucket that holds the target rank; observations beyond
+        the last finite bucket clamp to its upper bound (same convention
+        as Prometheus `histogram_quantile`)."""
+        if labels:
+            keys = [self._key(labels)]
+        else:
+            with self._lock:
+                keys = list(self._counts)
+        with self._lock:
+            merged = [0.0] * (len(self.buckets) + 1)
+            for key in keys:
+                for i, c in enumerate(self._counts.get(key, ())):
+                    merged[i] += c
+        total = sum(merged)
+        if total == 0:
+            return 0.0
+        target = q * total
+        cumulative = 0.0
+        for i, c in enumerate(merged):
+            if cumulative + c >= target and c > 0:
+                if i >= len(self.buckets):  # +Inf bucket
+                    return self.buckets[-1]
+                lower = self.buckets[i - 1] if i > 0 else 0.0
+                upper = self.buckets[i]
+                return lower + (upper - lower) * (target - cumulative) / c
+            cumulative += c
+        return self.buckets[-1]
+
+    def samples(self) -> List[Tuple[Dict[str, str], Dict[str, float]]]:
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        out = []
+        for key, counts in items:
+            labels = dict(zip(self.labelnames, key))
+            total = sum(counts)
+            buckets, cum = {}, 0.0
+            for le, c in zip(self.buckets, counts):
+                cum += c
+                buckets[_format_value(le)] = cum
+            buckets["+Inf"] = total
+            out.append((labels, {
+                "count": total, "sum": sums.get(key, 0.0),
+                "buckets": buckets}))
+        return out
+
+    def render(self, lines: List[str]) -> None:
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        for key, counts in items:
+            cumulative = 0.0
+            for le, c in zip(self.buckets, counts):
+                cumulative += c
+                lines.append(
+                    self.name + "_bucket"
+                    + _format_labels(self.labelnames, key,
+                                     extra=(("le", _format_value(le)),))
+                    + " " + _format_value(cumulative))
+            lines.append(
+                self.name + "_bucket"
+                + _format_labels(self.labelnames, key, extra=(("le", "+Inf"),))
+                + " " + _format_value(sum(counts)))
+            lines.append(self.name + "_sum"
+                         + _format_labels(self.labelnames, key)
+                         + " " + _format_value(sums.get(key, 0.0)))
+            lines.append(self.name + "_count"
+                         + _format_labels(self.labelnames, key)
+                         + " " + _format_value(sum(counts)))
+
+
+class MetricsRegistry:
+    """Named metrics, get-or-create, rendered in registration order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kwargs):
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is not None:
+                if metric.signature() != (cls.kind, tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{metric.signature()}, requested "
+                        f"{(cls.kind, tuple(labelnames))}")
+                return metric
+            metric = cls(name, help, labelnames, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def gauge_callback(self, name: str, help: str, fn: Callable,
+                       labelnames: Sequence[str] = ()) -> Gauge:
+        """Register (or re-point, idempotently) a scrape-time callback gauge."""
+        gauge = self._get_or_create(Gauge, name, help, labelnames)
+        gauge.set_function(fn)
+        return gauge
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def collect(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def render_prometheus(self) -> str:
+        return render_prometheus([self])
+
+    def render_json(self) -> dict:
+        out = {}
+        for metric in self.collect():
+            entry = {"kind": metric.kind, "help": metric.help}
+            if isinstance(metric, Histogram):
+                entry["samples"] = [
+                    {"labels": labels, "count": s["count"], "sum": s["sum"],
+                     "avg": (s["sum"] / s["count"]) if s["count"] else 0.0,
+                     "buckets": s["buckets"]}
+                    for labels, s in metric.samples()]
+                entry["p50"] = metric.quantile(0.50)
+                entry["p95"] = metric.quantile(0.95)
+                entry["p99"] = metric.quantile(0.99)
+            else:
+                entry["samples"] = [
+                    {"labels": labels, "value": value}
+                    for labels, value in metric.samples()]
+            out[metric.name] = entry
+        return out
+
+
+def render_prometheus(registries: Iterable[MetricsRegistry]) -> str:
+    """Merge several registries into one exposition; the first registry
+    to define a metric name wins (server-local metrics shadow globals)."""
+    lines: List[str] = []
+    seen = set()
+    for registry in registries:
+        for metric in registry.collect():
+            if metric.name in seen:
+                continue
+            seen.add(metric.name)
+            if metric.help:
+                lines.append(f"# HELP {metric.name} "
+                             f"{_escape_help(metric.help)}")
+            lines.append(f"# TYPE {metric.name} {metric.kind}")
+            metric.render(lines)
+    return "\n".join(lines) + "\n"
+
+
+def render_json(registries: Iterable[MetricsRegistry]) -> dict:
+    merged: dict = {}
+    for registry in registries:
+        for name, entry in registry.render_json().items():
+            merged.setdefault(name, entry)
+    return merged
+
+
+_default_registry = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-global registry (workflow + device metrics live here;
+    servers merge it into their /metrics exposition)."""
+    return _default_registry

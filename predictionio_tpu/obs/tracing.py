@@ -1,0 +1,133 @@
+"""Request tracing: request IDs + contextvar span API.
+
+Every request through the observability middleware gets a request ID
+(taken from an incoming ``X-Request-ID`` header or generated) and an
+active :class:`Trace` carried in a :mod:`contextvars` context, so
+``span("predict")`` anywhere below the handler records a named stage
+timing without threading arguments through every signature — the same
+pattern as ``utils.profiling.phase`` but per-request and async-safe.
+
+Span timings feed two places: the active trace (surfaced in structured
+slow-request log lines) and the owning registry's
+``pio_span_duration_seconds`` histogram (surfaced at ``/metrics``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from predictionio_tpu.obs.registry import MetricsRegistry
+
+logger = logging.getLogger("pio.obs")
+
+REQUEST_ID_HEADER = "X-Request-ID"
+
+_request_id_var: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("pio_request_id", default=None)
+_trace_var: contextvars.ContextVar[Optional["Trace"]] = \
+    contextvars.ContextVar("pio_trace", default=None)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex
+
+
+def current_request_id() -> Optional[str]:
+    return _request_id_var.get()
+
+
+def current_trace() -> Optional["Trace"]:
+    return _trace_var.get()
+
+
+def span_histogram(registry: MetricsRegistry):
+    """Resolve the span histogram once (callers on hot paths cache this)."""
+    return registry.histogram(
+        "pio_span_duration_seconds",
+        "Per-stage wall time recorded by span()", labelnames=("span",))
+
+
+class Trace:
+    """Per-request span accumulator."""
+
+    __slots__ = ("request_id", "registry", "span_hist", "spans")
+
+    def __init__(self, request_id: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 span_hist=None):
+        self.request_id = request_id
+        self.registry = registry
+        #: pre-resolved pio_span_duration_seconds handle — span() exits on
+        #: the query hot path must not take the registry lock per call
+        self.span_hist = span_hist
+        self.spans: List[Tuple[str, float]] = []
+
+    def add(self, name: str, seconds: float) -> None:
+        self.spans.append((name, seconds))
+
+    def spans_by_name(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name, seconds in self.spans:
+            out[name] = out.get(name, 0.0) + seconds
+        return out
+
+
+def start_trace(request_id: str,
+                registry: Optional[MetricsRegistry] = None,
+                span_hist=None):
+    """Install a fresh trace + request id; returns tokens for
+    :func:`reset_trace`."""
+    trace = Trace(request_id, registry, span_hist)
+    return (_request_id_var.set(request_id), _trace_var.set(trace)), trace
+
+
+def reset_trace(tokens) -> None:
+    rid_token, trace_token = tokens
+    _request_id_var.reset(rid_token)
+    _trace_var.reset(trace_token)
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricsRegistry] = None):
+    """Record this block's wall time as a named stage of the current
+    request (no-op-cheap when no trace/registry is active)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        trace = _trace_var.get()
+        hist = None
+        if trace is not None:
+            trace.add(name, dt)
+            if registry is None:
+                hist = trace.span_hist
+                if hist is None and trace.registry is not None:
+                    hist = span_histogram(trace.registry)
+        if hist is None and registry is not None:
+            hist = span_histogram(registry)
+        if hist is not None:
+            hist.observe(dt, span=name)
+
+
+def log_slow_request(service: str, method: str, path: str, status: int,
+                     duration_s: float, trace: Optional[Trace]) -> None:
+    """One structured line per over-threshold request (see
+    OBSERVABILITY.md for the format contract)."""
+    payload = {
+        "requestId": trace.request_id if trace else None,
+        "service": service,
+        "method": method,
+        "path": path,
+        "status": status,
+        "durationSec": round(duration_s, 6),
+        "spans": {name: round(secs, 6) for name, secs in
+                  (trace.spans_by_name() if trace else {}).items()},
+    }
+    logger.warning("slow request %s", json.dumps(payload, sort_keys=True))

@@ -28,6 +28,11 @@ def mesh_cached_fn(family: str, mesh, static_key: Hashable,
     fn = cache.get(key)
     if fn is None:
         fn = build()
+        from predictionio_tpu.obs.jax_stats import compile_counter
+
+        # a climbing pio_jax_compile_total on a serving box flags a
+        # retrace leak — exactly what this cache exists to prevent
+        compile_counter().inc(family=family)
         cache[key] = fn
         while len(cache) > MAX_PER_FAMILY:
             cache.popitem(last=False)

@@ -17,6 +17,8 @@ import logging
 
 from aiohttp import web
 
+from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
+from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
 from predictionio_tpu.storage.base import AccessKey, App
 from predictionio_tpu.storage.registry import Storage
 
@@ -120,13 +122,16 @@ async def handle_app_data_delete(request):
         {"status": 0, "message": f"App {name} does not exist."}, status=404)
 
 
-def create_admin_server() -> web.Application:
-    app = web.Application()
+def create_admin_server(registry: MetricsRegistry = None) -> web.Application:
+    registry = registry or MetricsRegistry()
+    app = web.Application(middlewares=[
+        observability_middleware(registry, "admin")])
     app.router.add_get("/", handle_root)
     app.router.add_get("/cmd/app", handle_app_list)
     app.router.add_post("/cmd/app", handle_app_new)
     app.router.add_delete("/cmd/app/{name}", handle_app_delete)
     app.router.add_delete("/cmd/app/{name}/data", handle_app_data_delete)
+    add_metrics_routes(app, registry, default_registry())
     return app
 
 

@@ -16,6 +16,10 @@ from typing import Optional
 
 from aiohttp import web
 
+from predictionio_tpu.obs.middleware import (
+    METRICS_PATHS, add_metrics_routes, observability_middleware,
+)
+from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.utils.server_config import ServerConfig
 
@@ -28,6 +32,8 @@ _SERVER_CONFIG = web.AppKey("server_config", ServerConfig)
 
 @web.middleware
 async def _key_auth_middleware(request, handler):
+    if request.path in METRICS_PATHS:  # scrapers hold no access keys
+        return await handler(request)
     cfg = request.app[_SERVER_CONFIG]
     if not cfg.check_key(request.query.get("accessKey")):
         return web.json_response({"message": "Unauthorized"}, status=401)
@@ -90,14 +96,19 @@ async def handle_detail_json(request):
     })
 
 
-def create_dashboard(server_config: Optional[ServerConfig] = None
+def create_dashboard(server_config: Optional[ServerConfig] = None,
+                     registry: Optional[MetricsRegistry] = None
                      ) -> web.Application:
-    app = web.Application(middlewares=[_key_auth_middleware])
+    registry = registry or MetricsRegistry()
+    app = web.Application(middlewares=[
+        observability_middleware(registry, "dashboard"),
+        _key_auth_middleware])
     app[_SERVER_CONFIG] = server_config or ServerConfig()
     app.router.add_get("/", handle_index)
     app.router.add_get("/engine_instances/{instance_id}", handle_detail)
     app.router.add_get("/evaluations.json", handle_index_json)
     app.router.add_get("/evaluations/{instance_id}.json", handle_detail_json)
+    add_metrics_routes(app, registry, default_registry())
     return app
 
 

@@ -30,6 +30,8 @@ from typing import Optional
 from aiohttp import web
 
 from predictionio_tpu.data.event import Event, EventValidationError, parse_event_time, validate_event
+from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
+from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
 from predictionio_tpu.server.plugins import PluginContext
 from predictionio_tpu.server.stats import Stats
 from predictionio_tpu.storage.base import StorageError
@@ -57,12 +59,26 @@ def _json_response(data, status=200):
 
 class EventServer:
     def __init__(self, stats: bool = False,
-                 plugin_context: Optional[PluginContext] = None):
+                 plugin_context: Optional[PluginContext] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.stats_enabled = stats
-        self.stats = Stats()
+        self.registry = registry or MetricsRegistry()
+        self.stats = Stats(registry=self.registry)
+        self._ingest_total = self.registry.counter(
+            "pio_event_ingest_total",
+            "Event ingest attempts by response status",
+            labelnames=("status",))
+        self._rejected_total = self.registry.counter(
+            "pio_event_rejected_total",
+            "Rejected events by reason (invalid/forbidden/blocked/storage)",
+            labelnames=("reason",))
+        self._batch_size = self.registry.histogram(
+            "pio_event_batch_size", "Events per /batch/events.json request",
+            buckets=(1, 2, 5, 10, 20, 50))
         self.plugins = plugin_context or PluginContext(
             "predictionio_tpu.eventserver_plugins")
-        self.app = web.Application()
+        self.app = web.Application(middlewares=[
+            observability_middleware(self.registry, "event_server")])
         self._routes()
 
     # -- auth ---------------------------------------------------------------
@@ -118,6 +134,12 @@ class EventServer:
         r.add_route("*", "/plugins/{tail:.*}", self.handle_plugin_rest)
         r.add_post("/webhooks/{name}.json", self.handle_webhook_post)
         r.add_get("/webhooks/{name}.json", self.handle_webhook_get)
+        add_metrics_routes(self.app, self.registry, default_registry())
+
+    def _ingest(self, status: int, reason: Optional[str] = None) -> None:
+        self._ingest_total.inc(status=str(status))
+        if reason is not None:
+            self._rejected_total.inc(reason=reason)
 
     async def handle_root(self, request):
         return _json_response({"status": "alive"})
@@ -130,19 +152,23 @@ class EventServer:
             validate_event(event)
         except (EventValidationError, json.JSONDecodeError, TypeError,
                 AttributeError, ValueError) as e:
+            self._ingest(400, "invalid")
             return _json_response({"message": str(e)}, status=400)
         if auth.events and event.event not in auth.events:
+            self._ingest(403, "forbidden")
             return _json_response(
                 {"message": f"{event.event} events are not allowed"}, status=403)
         for blocker in self.plugins.input_blockers.values():
             try:
                 blocker.process(auth.app_id, auth.channel_id, event)
             except Exception as e:  # blocker rejected the event
+                self._ingest(403, "blocked")
                 return _json_response({"message": str(e)}, status=403)
         try:
             event_id = await self._run(
                 Storage.get_events().insert, event, auth.app_id, auth.channel_id)
         except StorageError as e:
+            self._ingest(500, "storage_error")
             return _json_response({"message": str(e)}, status=500)
         for sniffer in self.plugins.input_sniffers.values():
             try:
@@ -151,6 +177,7 @@ class EventServer:
                 logger.exception("input sniffer failed")
         if self.stats_enabled:
             self.stats.bookkeeping(auth.app_id, 201, event)
+        self._ingest(201)
         return _json_response({"eventId": event_id}, status=201)
 
     async def handle_find(self, request):
@@ -230,6 +257,7 @@ class EventServer:
             return _json_response(
                 {"message": "Batch request must have less than or equal to "
                             f"{MAX_EVENTS_PER_BATCH} events"}, status=400)
+        self._batch_size.observe(len(body))
         results = []
         to_insert = []  # (index, event)
         for i, item in enumerate(body):
@@ -237,9 +265,11 @@ class EventServer:
                 event = Event.from_dict(item)
                 validate_event(event)
             except (EventValidationError, TypeError, AttributeError) as e:
+                self._ingest(400, "invalid")
                 results.append((i, {"status": 400, "message": str(e)}))
                 continue
             if auth.events and event.event not in auth.events:
+                self._ingest(403, "forbidden")
                 results.append((i, {
                     "status": 403,
                     "message": f"{event.event} events are not allowed"}))
@@ -249,6 +279,7 @@ class EventServer:
                 try:
                     blocker.process(auth.app_id, auth.channel_id, event)
                 except Exception as e:
+                    self._ingest(403, "blocked")
                     results.append((i, {"status": 403, "message": str(e)}))
                     blocked = True
                     break
@@ -260,8 +291,10 @@ class EventServer:
                     Storage.get_events().insert_batch,
                     [e for _, e in to_insert], auth.app_id, auth.channel_id)
             except StorageError as e:
+                self._ingest(500, "storage_error")
                 return _json_response({"message": str(e)}, status=500)
             for (i, event), event_id in zip(to_insert, ids):
+                self._ingest(201)
                 if self.stats_enabled:
                     self.stats.bookkeeping(auth.app_id, 201, event)
                 for sniffer in self.plugins.input_sniffers.values():
@@ -315,14 +348,17 @@ class EventServer:
             event = connector.to_event(payload)
             validate_event(event)
         except Exception as e:
+            self._ingest(400, "invalid")
             return _json_response({"message": str(e)}, status=400)
         try:
             event_id = await self._run(
                 Storage.get_events().insert, event, auth.app_id, auth.channel_id)
         except StorageError as e:
+            self._ingest(500, "storage_error")
             return _json_response({"message": str(e)}, status=500)
         if self.stats_enabled:
             self.stats.bookkeeping(auth.app_id, 201, event)
+        self._ingest(201)
         return _json_response({"eventId": event_id}, status=201)
 
     async def handle_webhook_get(self, request):
@@ -338,10 +374,12 @@ class EventServer:
 
 
 def create_event_server(stats: bool = False,
-                        plugin_context: Optional[PluginContext] = None
+                        plugin_context: Optional[PluginContext] = None,
+                        registry: Optional[MetricsRegistry] = None
                         ) -> web.Application:
     """EventServer.createEventServer:528 parity."""
-    return EventServer(stats=stats, plugin_context=plugin_context).app
+    return EventServer(stats=stats, plugin_context=plugin_context,
+                       registry=registry).app
 
 
 def run_event_server(ip: str = "localhost", port: int = DEFAULT_PORT,

@@ -35,6 +35,10 @@ from predictionio_tpu.core.engine import Engine, TrainResult
 from predictionio_tpu.core.params import params_from_json
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event, UTC
+from predictionio_tpu.obs.jax_stats import register_jax_metrics
+from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
+from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
+from predictionio_tpu.obs.tracing import span
 from predictionio_tpu.server.plugins import PluginContext
 from predictionio_tpu.storage.base import EngineInstance, generate_id
 from predictionio_tpu.storage.registry import Storage
@@ -143,7 +147,8 @@ class QueryServer:
                  access_key: Optional[str] = None,
                  plugin_context: Optional[PluginContext] = None,
                  log_url: Optional[str] = None,
-                 log_prefix: str = ""):
+                 log_prefix: str = "",
+                 registry: Optional[MetricsRegistry] = None):
         self.engine = engine
         self.result = train_result
         self.instance = instance
@@ -165,12 +170,28 @@ class QueryServer:
         self.plugins = plugin_context or PluginContext(
             "predictionio_tpu.engineserver_plugins")
         self.start_time = _dt.datetime.now(tz=UTC)
-        self.request_count = 0
-        self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self._stop_event = asyncio.Event()
         self.batcher = MicroBatcher(self._predict_batch)
-        self.app = web.Application()
+        self.registry = registry or MetricsRegistry()
+        register_jax_metrics(default_registry())
+        self._query_hist = self.registry.histogram(
+            "pio_query_duration_seconds",
+            "Query hot-path wall time by engine variant",
+            labelnames=("engine_variant",))
+        self._query_failures = self.registry.counter(
+            "pio_query_failures_total",
+            "Failed queries by engine variant and cause "
+            "(bad_json = client garbage, predict_error = engine failure)",
+            labelnames=("engine_variant", "reason"))
+        self._feedback_hist = self.registry.histogram(
+            "pio_feedback_write_duration_seconds",
+            "Feedback-loop event store write wall time")
+        self._reload_total = self.registry.counter(
+            "pio_reload_total", "Model reload attempts by outcome",
+            labelnames=("status",))
+        self.app = web.Application(middlewares=[
+            observability_middleware(self.registry, "query_server")])
         self._routes()
 
     def _routes(self):
@@ -180,9 +201,15 @@ class QueryServer:
         r.add_get("/reload", self.handle_reload)
         r.add_post("/stop", self.handle_stop)
         r.add_get("/plugins.json", self.handle_plugins)
+        add_metrics_routes(self.app, self.registry, default_registry())
 
     # -- info ---------------------------------------------------------------
     async def handle_root(self, request):
+        """Engine/instance info + serving stats (CreateServer.scala:460-482),
+        latency figures sourced from the metrics registry."""
+        count = self._query_hist.total_count()
+        total = self._query_hist.total_sum()
+        uptime = (_dt.datetime.now(tz=UTC) - self.start_time).total_seconds()
         return web.json_response({
             "status": "alive",
             "engineInstance": {
@@ -193,8 +220,11 @@ class QueryServer:
             },
             "algorithms": [type(a).__name__ for a in self.result.algorithms],
             "startTime": self.start_time.isoformat(),
-            "requestCount": self.request_count,
-            "avgServingSec": self.avg_serving_sec,
+            "uptimeSeconds": uptime,
+            "requestCount": int(count),
+            "queryCount": int(count),
+            "avgServingSec": (total / count) if count else 0.0,
+            "p95ServingSec": self._query_hist.quantile(0.95),
             "lastServingSec": self.last_serving_sec,
         })
 
@@ -222,22 +252,31 @@ class QueryServer:
     # -- hot path (CreateServer.scala:484-605) -------------------------------
     async def handle_query(self, request):
         t0 = time.perf_counter()
+        variant = self.instance.engine_variant
         try:
             body = await request.json()
         except json.JSONDecodeError as e:
+            self._query_failures.inc(engine_variant=variant,
+                                     reason="bad_json")
             return web.json_response({"message": str(e)}, status=400)
         try:
-            query = self._extract_query(body)
-            if self._vectorized():
-                prediction = await self.batcher.submit(query)
-            else:
-                # no vectorized batch_predict to exploit — per-request
-                # thread-pool parallelism beats serializing into one batch
-                loop = asyncio.get_running_loop()
-                prediction = await loop.run_in_executor(
-                    None, self._predict, query)
+            # spans resolve through the middleware-installed trace, which
+            # carries a pre-resolved histogram handle (no lock on hot path)
+            with span("extract_query"):
+                query = self._extract_query(body)
+            with span("predict"):
+                if self._vectorized():
+                    prediction = await self.batcher.submit(query)
+                else:
+                    # no vectorized batch_predict to exploit — per-request
+                    # thread-pool parallelism beats serializing into one batch
+                    loop = asyncio.get_running_loop()
+                    prediction = await loop.run_in_executor(
+                        None, self._predict, query)
         except Exception as e:
             logger.exception("query failed")
+            self._query_failures.inc(engine_variant=variant,
+                                     reason="predict_error")
             if self.log_url:
                 await self._remote_log(
                     f"Query:\n{json.dumps(body)}\n\nError:\n{e!r}\n\n")
@@ -266,9 +305,8 @@ class QueryServer:
                 logger.exception("output sniffer failed")
 
         dt = time.perf_counter() - t0
-        self.request_count += 1
         self.last_serving_sec = dt
-        self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        self._query_hist.observe(dt, engine_variant=variant)
         return web.json_response(pred_json)
 
     def _extract_query(self, body: dict):
@@ -334,6 +372,7 @@ class QueryServer:
 
     def _record_feedback(self, query_json, pred_json, pr_id):
         """Write predict/actual linkage events (CreateServer.scala:563-589)."""
+        t0 = time.perf_counter()
         try:
             app_id, channel_id = self._feedback_target
             event = Event(
@@ -344,6 +383,7 @@ class QueryServer:
                                     "prediction": pred_json}),
             )
             Storage.get_events().insert(event, app_id, channel_id)
+            self._feedback_hist.observe(time.perf_counter() - t0)
         except Exception:
             logger.exception("feedback recording failed")
 
@@ -364,6 +404,7 @@ class QueryServer:
             self.instance.engine_id, self.instance.engine_version,
             self.instance.engine_variant)
         if latest is None:
+            self._reload_total.inc(status="not_found")
             return web.json_response(
                 {"message": "No COMPLETED instance found"}, status=404)
         loop = asyncio.get_running_loop()
@@ -373,6 +414,7 @@ class QueryServer:
         self.result = result
         self.ctx = ctx
         self.instance = latest
+        self._reload_total.inc(status="reloaded")
         logger.info("reloaded engine instance %s", latest.id)
         return web.json_response({"message": "Reloaded",
                                   "engineInstanceId": latest.id})

@@ -18,6 +18,7 @@ from predictionio_tpu.data.event import UTC
 from predictionio_tpu.storage.base import EvaluationInstance
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.workflow.context import WorkflowContext, WorkflowParams
+from predictionio_tpu.workflow.instrument import workflow_run_metrics
 
 logger = logging.getLogger("pio.workflow")
 
@@ -46,7 +47,8 @@ def run_evaluation(evaluation: Evaluation,
     instance.id = instance_id
     logger.info("EvaluationInstance %s created (INIT)", instance_id)
 
-    result = evaluation.run(ctx, engine_params_list)
+    with workflow_run_metrics("evaluate", "pio_eval"):
+        result = evaluation.run(ctx, engine_params_list)
 
     instance.status = "EVALCOMPLETED"
     instance.end_time = _dt.datetime.now(tz=UTC)

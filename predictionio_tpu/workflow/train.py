@@ -21,6 +21,7 @@ from predictionio_tpu.data.event import UTC
 from predictionio_tpu.storage.base import EngineInstance, Model
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.workflow.context import WorkflowContext, WorkflowParams
+from predictionio_tpu.workflow.instrument import workflow_run_metrics
 from predictionio_tpu.workflow.serialization import serialize_models
 
 logger = logging.getLogger("pio.workflow")
@@ -62,24 +63,25 @@ def run_train(engine: Engine,
     # on the backend mutating the record in place
     logger.info("EngineInstance %s created (INIT)", instance_id)
 
-    # CoreWorkflow.runTrain:45 — train, persist, mark COMPLETED
-    result = engine.train(
-        ctx, engine_params,
-        skip_sanity_check=wp.skip_sanity_check,
-        stop_after_read=wp.stop_after_read,
-        stop_after_prepare=wp.stop_after_prepare)
+    with workflow_run_metrics("train", "pio_train"):
+        # CoreWorkflow.runTrain:45 — train, persist, mark COMPLETED
+        result = engine.train(
+            ctx, engine_params,
+            skip_sanity_check=wp.skip_sanity_check,
+            stop_after_read=wp.stop_after_read,
+            stop_after_prepare=wp.stop_after_prepare)
 
-    if wp.save_model:
-        persisted = engine.persist_models(ctx, instance_id, result)
-        blob = serialize_models(persisted)
-        Storage.get_model_data_models().insert(
-            Model(id=instance_id, models=blob))
-        logger.info("models saved (%d bytes) for instance %s",
-                    len(blob), instance_id)
+        if wp.save_model:
+            persisted = engine.persist_models(ctx, instance_id, result)
+            blob = serialize_models(persisted)
+            Storage.get_model_data_models().insert(
+                Model(id=instance_id, models=blob))
+            logger.info("models saved (%d bytes) for instance %s",
+                        len(blob), instance_id)
 
-    instance.status = "COMPLETED"
-    instance.end_time = _dt.datetime.now(tz=UTC)
-    instances.update(instance)
+        instance.status = "COMPLETED"
+        instance.end_time = _dt.datetime.now(tz=UTC)
+        instances.update(instance)
     if getattr(ctx, "checkpointer", None) is not None:
         # resume is for crashed/preempted runs only: a completed run clears
         # its snapshots so the next train never resumes from stale factors

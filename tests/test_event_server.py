@@ -212,6 +212,66 @@ async def test_stats(client):
     assert body["longLive"][0]["event"] == "view"
 
 
+def test_stats_window_roll_preserves_previous_hour():
+    """On an hourly roll the old window becomes prevHourly instead of
+    being silently dropped (the reference Stats.scala behaviour)."""
+    import datetime
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.obs.registry import MetricsRegistry
+    from predictionio_tpu.server.stats import Stats
+
+    stats = Stats(registry=MetricsRegistry())
+    ev = Event(event="view", entity_type="user", entity_id="u1")
+    stats.bookkeeping(7, 201, ev)
+    # simulate crossing into the next hour
+    stats._hour_start -= datetime.timedelta(hours=1)
+    stats.bookkeeping(7, 201, ev)
+    stats.bookkeeping(7, 400, ev)
+    out = stats.get(7)
+    assert out["prevHourly"] == [
+        {"status": 201, "event": "view", "entityType": "user", "count": 1}]
+    assert {r["status"]: r["count"] for r in out["hourly"]} == {201: 1, 400: 1}
+    # longLive spans both windows (registry-backed)
+    assert {r["status"]: r["count"] for r in out["longLive"]} == {201: 2, 400: 1}
+
+
+def test_stats_window_roll_after_gap_clears_prev():
+    import datetime
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.obs.registry import MetricsRegistry
+    from predictionio_tpu.server.stats import Stats
+
+    stats = Stats(registry=MetricsRegistry())
+    ev = Event(event="view", entity_type="user", entity_id="u1")
+    stats.bookkeeping(7, 201, ev)
+    stats._hour_start -= datetime.timedelta(hours=3)  # idle for 3 hours
+    stats.bookkeeping(7, 201, ev)
+    assert stats.get(7)["prevHourly"] == []
+
+
+def test_stats_bookkeeping_series_cap(monkeypatch):
+    """Client-supplied event names cannot grow the /metrics exposition
+    without bound: past the cap new combos collapse into __other__."""
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.obs.registry import MetricsRegistry
+    from predictionio_tpu.server import stats as stats_mod
+
+    monkeypatch.setattr(stats_mod, "MAX_BOOKKEEPING_SERIES", 3)
+    stats = stats_mod.Stats(registry=MetricsRegistry())
+    for i in range(6):
+        stats.bookkeeping(7, 201, Event(event=f"ev{i}", entity_type="user",
+                                        entity_id="u1"))
+    # existing series keep counting exactly
+    stats.bookkeeping(7, 201, Event(event="ev0", entity_type="user",
+                                    entity_id="u1"))
+    assert stats._longlive.series_count() == 4  # 3 real + __other__
+    counts = {r["event"]: r["count"] for r in stats.get(7)["longLive"]}
+    assert counts["ev0"] == 2
+    assert counts["__other__"] == 3
+
+
 async def test_stats_disabled(backend):
     app = create_event_server(stats=False)
     c = TestClient(TestServer(app))

@@ -1,0 +1,273 @@
+"""Observability middleware + /metrics integration tests.
+
+The acceptance bar for the obs subsystem: start the real aiohttp apps
+(event server + query server), push traffic through them, scrape
+GET /metrics, and parse the Prometheus text exposition — latency
+histograms must show nonzero counts and request IDs must propagate into
+response headers.
+"""
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+pytestmark = pytest.mark.anyio
+
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
+from predictionio_tpu.obs.registry import MetricsRegistry
+from predictionio_tpu.obs.tracing import span
+from predictionio_tpu.server.event_server import create_event_server
+from predictionio_tpu.server.query_server import create_query_server
+from predictionio_tpu.storage import AccessKey, App, Storage
+from predictionio_tpu.workflow.train import load_for_deploy, run_train
+from fake_engine import Algo0, AlgoParams, DataSource0, Preparator0, Serving0
+
+from test_obs_registry import parse_exposition
+
+
+@pytest.fixture()
+def backend(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite", "PATH": str(tmp_path / "obs.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name="obsapp"))
+    Storage.get_events().init_channel(app_id)
+    key = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key="", appid=app_id, events=()))
+    yield {"app_id": app_id, "key": key}
+    Storage.reset()
+
+
+EV = {"event": "view", "entityType": "user", "entityId": "u1",
+      "targetEntityType": "item", "targetEntityId": "i1"}
+
+
+# -- middleware unit behaviour on a bare app ---------------------------------
+
+@pytest.fixture()
+async def bare_client():
+    registry = MetricsRegistry()
+
+    async def ok(request):
+        with span("stage_one"):
+            pass
+        return web.json_response({"ok": True})
+
+    async def boom(request):
+        raise web.HTTPConflict()
+
+    async def crash(request):
+        raise ValueError("handler bug")
+
+    app = web.Application(middlewares=[
+        observability_middleware(registry, "bare", slow_threshold_s=0.0)])
+    app.router.add_get("/ok", ok)
+    app.router.add_get("/boom", boom)
+    app.router.add_get("/crash", crash)
+    add_metrics_routes(app, registry)
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    yield c, registry
+    await c.close()
+
+
+async def test_request_id_generated_and_returned(bare_client):
+    c, _ = bare_client
+    resp = await c.get("/ok")
+    rid = resp.headers.get("X-Request-ID")
+    assert rid and len(rid) == 32
+
+
+async def test_incoming_request_id_propagates(bare_client):
+    c, _ = bare_client
+    resp = await c.get("/ok", headers={"X-Request-ID": "trace-me-123"})
+    assert resp.headers["X-Request-ID"] == "trace-me-123"
+
+
+async def test_request_id_on_http_exception(bare_client):
+    c, _ = bare_client
+    resp = await c.get("/boom")
+    assert resp.status == 409
+    assert resp.headers.get("X-Request-ID")
+
+
+async def test_request_id_on_unhandled_handler_error(bare_client):
+    """Crash responses are the ones an operator most needs to correlate."""
+    c, registry = bare_client
+    resp = await c.get("/crash", headers={"X-Request-ID": "crash-rid"})
+    assert resp.status == 500
+    assert resp.headers["X-Request-ID"] == "crash-rid"
+    assert (await resp.json()) == {"message": "Internal Server Error"}
+    hist = registry.get("pio_http_request_duration_seconds")
+    assert hist.count(service="bare", method="GET", handler="/crash",
+                      status="500") == 1
+
+
+async def test_duration_histogram_labels_by_handler_and_status(bare_client):
+    c, registry = bare_client
+    await c.get("/ok")
+    await c.get("/boom")
+    await c.get("/nope")  # unmatched -> 404
+    hist = registry.get("pio_http_request_duration_seconds")
+    assert hist.count(service="bare", method="GET", handler="/ok",
+                      status="200") == 1
+    assert hist.count(service="bare", method="GET", handler="/boom",
+                      status="409") == 1
+    assert hist.total_count() == 3
+
+
+async def test_slow_request_log_includes_spans(bare_client, caplog):
+    c, _ = bare_client
+    with caplog.at_level("WARNING", logger="pio.obs"):
+        await c.get("/ok", headers={"X-Request-ID": "slowrid"})
+    slow = [r.message for r in caplog.records if "slow request" in r.message]
+    assert slow, "threshold 0 must mark every request slow"
+    assert '"requestId": "slowrid"' in slow[0]
+    assert '"stage_one"' in slow[0]
+    assert '"service": "bare"' in slow[0]
+
+
+async def test_span_histogram_recorded(bare_client):
+    c, registry = bare_client
+    await c.get("/ok")
+    spans = registry.get("pio_span_duration_seconds")
+    assert spans is not None and spans.count(span="stage_one") == 1
+
+
+# -- event server integration ------------------------------------------------
+
+async def test_event_server_metrics_scrape(backend):
+    registry = MetricsRegistry()
+    app = create_event_server(stats=True, registry=registry)
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    try:
+        key = backend["key"]
+        for _ in range(3):
+            resp = await c.post(f"/events.json?accessKey={key}", json=EV)
+            assert resp.status == 201
+            assert resp.headers.get("X-Request-ID")
+        # one rejected event and one batch
+        bad = await c.post(f"/events.json?accessKey={key}",
+                           json={"event": "view"})
+        assert bad.status == 400
+        batch = [dict(EV, entityId=f"u{i}") for i in range(4)]
+        assert (await c.post(f"/batch/events.json?accessKey={key}",
+                             json=batch)).status == 200
+
+        resp = await c.get("/metrics")
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        samples, types = parse_exposition(await resp.text())
+
+        assert types["pio_http_request_duration_seconds"] == "histogram"
+        ok_count = samples['pio_http_request_duration_seconds_count'
+                           '{service="event_server",method="POST",'
+                           'handler="/events.json",status="201"}']
+        assert ok_count == 3
+        assert samples['pio_event_ingest_total{status="201"}'] == 7
+        assert samples['pio_event_ingest_total{status="400"}'] == 1
+        assert samples['pio_event_rejected_total{reason="invalid"}'] == 1
+        assert samples['pio_event_batch_size_count'] == 1
+        assert samples['pio_event_batch_size_bucket{le="5"}'] == 1
+        # Stats bookkeeping published through the same registry
+        assert samples[
+            'pio_event_bookkeeping_total{app_id="%d",status="201",'
+            'event="view",entity_type="user"}' % backend["app_id"]] == 7
+
+        # JSON twin endpoint
+        resp = await c.get("/metrics.json")
+        body = await resp.json()
+        assert body["pio_event_ingest_total"]["kind"] == "counter"
+    finally:
+        await c.close()
+
+
+async def test_stats_json_shape_with_prev_hourly(backend):
+    app = create_event_server(stats=True, registry=MetricsRegistry())
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    try:
+        key = backend["key"]
+        assert (await c.post(f"/events.json?accessKey={key}",
+                             json=EV)).status == 201
+        resp = await c.get(f"/stats.json?accessKey={key}")
+        body = await resp.json()
+        assert set(body) == {"startTime", "hourly", "longLive", "prevHourly"}
+        assert body["hourly"] == body["longLive"]
+        assert body["longLive"] == [{"status": 201, "event": "view",
+                                     "entityType": "user", "count": 1}]
+        assert body["prevHourly"] == []
+    finally:
+        await c.close()
+
+
+# -- query server integration ------------------------------------------------
+
+@pytest.fixture()
+def deployed(backend):
+    engine = Engine(DataSource0, Preparator0, {"a": Algo0}, Serving0)
+    params = EngineParams(algorithm_params_list=[("a", AlgoParams(id=3))])
+    instance = run_train(engine, params, engine_factory="tests.fake:engine",
+                         engine_variant="obs-variant")
+    result, ctx = load_for_deploy(engine, instance)
+    return engine, result, instance, ctx
+
+
+@pytest.fixture()
+async def query_client(deployed):
+    engine, result, instance, ctx = deployed
+    registry = MetricsRegistry()
+    server = create_query_server(engine, result, instance, ctx,
+                                 registry=registry)
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    yield c, registry
+    await c.close()
+
+
+async def test_query_server_metrics_scrape(query_client):
+    c, registry = query_client
+    for i in range(5):
+        resp = await c.post("/queries.json", json={"id": i})
+        assert resp.status == 200
+        assert resp.headers.get("X-Request-ID")
+    assert (await c.post("/queries.json", data=b"not json")).status == 400
+
+    resp = await c.get("/metrics")
+    assert resp.status == 200
+    samples, types = parse_exposition(await resp.text())
+    assert types["pio_query_duration_seconds"] == "histogram"
+    assert samples['pio_query_duration_seconds_count'
+                   '{engine_variant="obs-variant"}'] == 5
+    assert samples['pio_query_duration_seconds_sum'
+                   '{engine_variant="obs-variant"}'] > 0
+    assert samples['pio_query_failures_total'
+                   '{engine_variant="obs-variant",reason="bad_json"}'] == 1
+    # hot-path spans
+    assert samples['pio_span_duration_seconds_count{span="predict"}'] == 5
+    http_ok = samples['pio_http_request_duration_seconds_count'
+                      '{service="query_server",method="POST",'
+                      'handler="/queries.json",status="200"}']
+    assert http_ok == 5
+
+
+async def test_query_server_root_serving_stats(query_client):
+    c, _ = query_client
+    for i in range(3):
+        assert (await c.post("/queries.json", json={"id": i})).status == 200
+    info = await (await c.get("/")).json()
+    assert info["queryCount"] == 3
+    assert info["requestCount"] == 3  # back-compat alias
+    assert info["uptimeSeconds"] >= 0
+    assert info["avgServingSec"] > 0
+    assert info["p95ServingSec"] > 0
+    assert info["lastServingSec"] > 0
+    assert info["engineInstance"]["engineVariant"] == "obs-variant"

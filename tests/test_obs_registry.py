@@ -1,0 +1,225 @@
+"""Unit tests for the obs metrics registry (predictionio_tpu/obs/)."""
+
+import json
+import re
+import threading
+
+import pytest
+
+from predictionio_tpu.obs.registry import (
+    DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
+    default_registry, exponential_buckets, render_prometheus,
+)
+
+#: every non-comment exposition line: name{labels?} value
+SAMPLE_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (-?\d+(\.\d+)?([eE]-?\d+)?|\+Inf|-Inf|NaN)$')
+
+
+def parse_exposition(text):
+    """-> {name{labels}: float} plus the set of TYPE declarations."""
+    samples, types = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        assert SAMPLE_LINE.match(line), f"malformed exposition line: {line!r}"
+        key, value = line.rsplit(" ", 1)
+        samples[key] = float(value.replace("+Inf", "inf"))
+    return samples, types
+
+
+# -- counters ----------------------------------------------------------------
+
+def test_counter_inc_and_value():
+    r = MetricsRegistry()
+    c = r.counter("pio_x_total", "x", labelnames=("status",))
+    c.inc(status="201")
+    c.inc(2, status="201")
+    c.inc(status="400")
+    assert c.value(status="201") == 3
+    assert c.value(status="400") == 1
+    assert c.value(status="999") == 0
+
+
+def test_counter_rejects_negative_and_wrong_labels():
+    c = Counter("pio_x_total", labelnames=("a",))
+    with pytest.raises(ValueError):
+        c.inc(-1, a="v")
+    with pytest.raises(ValueError):
+        c.inc(b="v")
+    with pytest.raises(ValueError):
+        c.inc()  # missing label
+
+
+def test_get_or_create_returns_same_object_and_rejects_mismatch():
+    r = MetricsRegistry()
+    a = r.counter("pio_x_total", labelnames=("s",))
+    b = r.counter("pio_x_total", labelnames=("s",))
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("pio_x_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        r.counter("pio_x_total", labelnames=("other",))  # label mismatch
+
+
+def test_concurrent_increments_from_threads_are_exact():
+    r = MetricsRegistry()
+    c = r.counter("pio_thr_total", labelnames=("t",))
+    h = r.histogram("pio_thr_seconds")
+    n_threads, per_thread = 8, 2000
+
+    def work(i):
+        for _ in range(per_thread):
+            c.inc(t=str(i % 2))
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(t="0") + c.value(t="1") == n_threads * per_thread
+    assert h.count() == n_threads * per_thread
+
+
+# -- histograms --------------------------------------------------------------
+
+def test_histogram_bucketing_cumulative():
+    r = MetricsRegistry()
+    h = r.histogram("pio_h_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 5.0, 50.0):
+        h.observe(v)
+    samples, types = parse_exposition(render_prometheus([r]))
+    assert types["pio_h_seconds"] == "histogram"
+    # le="0.1" counts 0.05 and the boundary value 0.1 itself
+    assert samples['pio_h_seconds_bucket{le="0.1"}'] == 2
+    assert samples['pio_h_seconds_bucket{le="1"}'] == 3
+    assert samples['pio_h_seconds_bucket{le="10"}'] == 4
+    assert samples['pio_h_seconds_bucket{le="+Inf"}'] == 5
+    assert samples['pio_h_seconds_count'] == 5
+    assert samples['pio_h_seconds_sum'] == pytest.approx(55.65)
+
+
+def test_histogram_quantiles_interpolate():
+    h = Histogram("pio_q_seconds", buckets=tuple(0.01 * i for i in range(1, 101)))
+    for i in range(1000):
+        h.observe((i % 100) * 0.01 + 0.001)
+    assert h.quantile(0.5) == pytest.approx(0.5, abs=0.02)
+    assert h.quantile(0.95) == pytest.approx(0.95, abs=0.02)
+    assert h.quantile(0.99) == pytest.approx(0.99, abs=0.02)
+
+
+def test_histogram_quantile_clamps_to_last_finite_bucket():
+    h = Histogram("pio_q_seconds", buckets=(1.0,))
+    h.observe(100.0)
+    assert h.quantile(0.99) == 1.0
+    assert Histogram("pio_e_seconds").quantile(0.5) == 0.0  # empty
+
+
+def test_default_buckets_are_exponential():
+    assert DEFAULT_LATENCY_BUCKETS[0] == pytest.approx(0.0005)
+    ratios = {round(b / a, 6) for a, b in zip(DEFAULT_LATENCY_BUCKETS,
+                                              DEFAULT_LATENCY_BUCKETS[1:])}
+    assert ratios == {2.0}
+    assert exponential_buckets(1, 10, 3) == (1, 10, 100)
+    with pytest.raises(ValueError):
+        exponential_buckets(0, 2, 3)
+
+
+def test_histogram_per_label_and_merged_stats():
+    h = Histogram("pio_v_seconds", labelnames=("variant",), buckets=(1.0, 10.0))
+    h.observe(0.5, variant="a")
+    h.observe(0.5, variant="a")
+    h.observe(5.0, variant="b")
+    assert h.count(variant="a") == 2
+    assert h.sum_(variant="b") == 5.0
+    assert h.total_count() == 3
+    assert h.total_sum() == 6.0
+
+
+# -- gauges ------------------------------------------------------------------
+
+def test_gauge_set_inc_dec():
+    g = Gauge("pio_g")
+    g.set(10)
+    g.inc()
+    g.dec(2)
+    assert g.samples() == [({}, 9.0)]
+
+
+def test_gauge_callback_evaluated_at_scrape():
+    r = MetricsRegistry()
+    state = {"v": 1.0}
+    r.gauge_callback("pio_cb", "cb", lambda: state["v"])
+    samples, _ = parse_exposition(render_prometheus([r]))
+    assert samples["pio_cb"] == 1.0
+    state["v"] = 7.0
+    samples, _ = parse_exposition(render_prometheus([r]))
+    assert samples["pio_cb"] == 7.0
+
+
+def test_gauge_callback_errors_render_nothing():
+    r = MetricsRegistry()
+
+    def boom():
+        raise RuntimeError("nope")
+
+    r.gauge_callback("pio_cb", "cb", boom)
+    samples, types = parse_exposition(render_prometheus([r]))
+    assert "pio_cb" in types and "pio_cb" not in samples
+
+
+# -- rendering ---------------------------------------------------------------
+
+def test_label_escaping():
+    r = MetricsRegistry()
+    c = r.counter("pio_esc_total", labelnames=("v",))
+    c.inc(v='quote " backslash \\ newline \n end')
+    text = render_prometheus([r])
+    line = [l for l in text.splitlines() if not l.startswith("#")][0]
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n end" not in line  # raw newline must not split the line
+    samples, _ = parse_exposition(text)
+    assert list(samples.values()) == [1.0]
+
+
+def test_help_escaping_and_type_lines():
+    r = MetricsRegistry()
+    r.counter("pio_h_total", "multi\nline \\ help")
+    text = render_prometheus([r])
+    assert "# HELP pio_h_total multi\\nline \\\\ help" in text
+    assert "# TYPE pio_h_total counter" in text
+    assert text.endswith("\n")
+
+
+def test_multi_registry_merge_first_wins():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("pio_shared_total").inc(5)
+    b.counter("pio_shared_total").inc(9)
+    b.counter("pio_only_b_total").inc()
+    samples, _ = parse_exposition(render_prometheus([a, b]))
+    assert samples["pio_shared_total"] == 5.0
+    assert samples["pio_only_b_total"] == 1.0
+
+
+def test_render_json_histogram_summaries():
+    r = MetricsRegistry()
+    h = r.histogram("pio_j_seconds", "j", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    h.observe(1.5)
+    out = json.loads(json.dumps(r.render_json()))  # must be JSON-serializable
+    entry = out["pio_j_seconds"]
+    assert entry["kind"] == "histogram"
+    assert entry["samples"][0]["count"] == 2
+    assert entry["samples"][0]["avg"] == pytest.approx(1.0)
+    assert set(entry) >= {"p50", "p95", "p99"}
+    assert entry["samples"][0]["buckets"]["+Inf"] == 2
+
+
+def test_default_registry_is_process_wide_singleton():
+    assert default_registry() is default_registry()

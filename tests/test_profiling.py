@@ -1,0 +1,62 @@
+"""utils.profiling: contextvar-based phase sinks (thread/task safety)."""
+
+import threading
+import time
+
+from predictionio_tpu.utils.profiling import collect_phases, phase
+
+
+def test_phase_accumulates_into_sink():
+    with collect_phases({}) as sink:
+        with phase("build"):
+            time.sleep(0.01)
+        with phase("build"):
+            pass
+        with phase("transfer"):
+            pass
+    assert set(sink) == {"build", "transfer"}
+    assert sink["build"] >= 0.01
+
+
+def test_phase_without_sink_is_noop():
+    with phase("orphan"):
+        pass  # must not raise
+
+
+def test_nested_collect_phases_restores_outer():
+    with collect_phases({}) as outer:
+        with phase("a"):
+            pass
+        with collect_phases({}) as inner:
+            with phase("b"):
+                pass
+        with phase("c"):
+            pass
+    assert set(outer) == {"a", "c"}
+    assert set(inner) == {"b"}
+
+
+def test_concurrent_sinks_do_not_clobber_each_other():
+    """The original module-global sink let thread B's collect_phases
+    capture thread A's phases; ContextVar keeps them isolated."""
+    results = {}
+    barrier = threading.Barrier(4)
+
+    def work(name):
+        with collect_phases({}) as sink:
+            barrier.wait()  # everyone installs a sink before any phase runs
+            for _ in range(50):
+                with phase(name):
+                    time.sleep(0.0001)
+            barrier.wait()  # nobody uninstalls until everyone recorded
+        results[name] = sink
+
+    threads = [threading.Thread(target=work, args=(f"t{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(4):
+        assert set(results[f"t{i}"]) == {f"t{i}"}, \
+            "phase timings leaked across concurrent sinks"

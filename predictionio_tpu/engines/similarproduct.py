@@ -237,6 +237,29 @@ class ALSAlgorithm(Algorithm):
         scores = model.V @ qsum
         return _score_and_filter(model, scores, query, query_idx)
 
+    def batch_predict(self, model: SimilarityModel, queries):
+        """Vectorized batch scorer (the query-server micro-batch path):
+        B summed-cosine matvecs collapse into one [B, K] @ [K, N] BLAS
+        matmul; per-query candidate filtering stays on host. The server
+        hands this a bucketed, padded batch (ops/bucketing), so B is
+        already shape-stable."""
+        idx_sets = []
+        for _, q in queries:
+            idx_sets.append({i for i in (model.item_index(x)
+                                         for x in q.items) if i is not None})
+        rows = [b for b, qi in enumerate(idx_sets) if qi]
+        out = [(i, PredictedResult(item_scores=[])) for i, _ in queries]
+        if not rows:
+            return out
+        qsums = np.stack([model.V[sorted(idx_sets[b])].sum(axis=0)
+                          for b in rows])
+        scores = qsums @ model.V.T                       # [B, N] host BLAS
+        for r, b in enumerate(rows):
+            i, q = queries[b]
+            out[b] = (i, _score_and_filter(model, scores[r], q,
+                                           idx_sets[b]))
+        return out
+
 
 class LikeAlgorithm(ALSAlgorithm):
     """LikeAlgorithm.scala parity: latest like/dislike per (user, item),
@@ -302,6 +325,14 @@ class CooccurrenceAlgorithm(Algorithm):
                 m.items.get(idx), query.categories))
         return PredictedResult(item_scores=[
             ItemScore(item=i, score=c) for i, c in similar])
+
+    def batch_predict(self, m: CooccurrenceEngineModel, queries):
+        """Cooccurrence scoring is host-side top-list merging (microseconds
+        per query) — there is nothing to vectorize, but the override opts
+        the whole multi-algo engine into the query server's micro-batched
+        path, where the expensive sibling (ALSAlgorithm's batched matmul)
+        pays for the coalescing."""
+        return [(i, self.predict(m, q)) for i, q in queries]
 
 
 class SimilarProductServing(FirstServing):

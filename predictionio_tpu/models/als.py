@@ -33,6 +33,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.core.params import Params
 from predictionio_tpu.data.bimap import vocab_index
+from predictionio_tpu.ops.bucketing import bucket_size, pad_rows as _pad_rows
+from predictionio_tpu.ops.fn_cache import shape_cached_fn
 from predictionio_tpu.ops.linalg import batched_spd_solve
 from predictionio_tpu.ops.segment import rows_gram_rhs, segment_count
 from predictionio_tpu.ops.topk import host_topk as _host_topk
@@ -906,27 +908,33 @@ class ALSModel:
                     scores[b, m] = -np.inf
             scores, idx = _host_topk(scores, k)
         else:
-            # bucket B and k to powers of two so the serving path compiles
-            # a handful of shapes instead of one per (batch, num) combo —
-            # an un-bucketed jit would stall whole batches on recompiles
-            b_pad = 1 << (len(rows) - 1).bit_length()
-            k_pad = min(1 << max(k - 1, 0).bit_length(), n_items)
-            if b_pad > len(rows):
-                u_batch = np.concatenate(
-                    [u_batch,
-                     np.zeros((b_pad - len(rows), u_batch.shape[1]),
-                              u_batch.dtype)])
+            # bucket B and k to powers of two (ops/bucketing — the rule
+            # the serving micro-batcher shares) so this scorer compiles a
+            # handful of shapes instead of one per (batch, num) combo; an
+            # un-bucketed jit would stall whole batches on recompiles
+            b_pad = bucket_size(len(rows))
+            k_pad = min(bucket_size(k), n_items)
+            u_batch = _pad_rows(u_batch, b_pad)
+            rank = u_batch.shape[1]
             if any_mask:
                 mask = np.stack(
                     [self._query_mask(requests[j][2], requests[j][3])
                      for j in rows]
                     + [np.ones(n_items, bool)] * (b_pad - len(rows)))
-                scores, idx = _topk_scores_batch(
-                    jnp.asarray(u_batch), self.V_device, jnp.asarray(mask),
-                    k_pad)
+                # shape_cached_fn returns the SAME shared jit (compiles
+                # live in jit's cache); its build counter is the
+                # per-bucket compile ledger pio_jax_compile_total reads
+                fn = shape_cached_fn(
+                    "als_topk_masked", (b_pad, k_pad, n_items, rank),
+                    lambda: _topk_scores_batch)
+                scores, idx = fn(jnp.asarray(u_batch), self.V_device,
+                                 jnp.asarray(mask), k_pad)
             else:
-                scores, idx = _topk_scores_batch_nomask(
-                    jnp.asarray(u_batch), self.V_device, k_pad)
+                fn = shape_cached_fn(
+                    "als_topk", (b_pad, k_pad, n_items, rank),
+                    lambda: _topk_scores_batch_nomask)
+                scores, idx = fn(jnp.asarray(u_batch), self.V_device,
+                                 k_pad)
             scores, idx = jax.device_get((scores, idx))  # one fetch
             scores = scores[:len(rows), :k]
             idx = idx[:len(rows), :k]

@@ -22,11 +22,14 @@ written back to the event store as a `predict` event with prId tagging.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import datetime as _dt
+import functools
 import json
 import logging
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
 from aiohttp import web
@@ -38,14 +41,39 @@ from predictionio_tpu.data.event import Event, UTC
 from predictionio_tpu.obs.jax_stats import register_jax_metrics
 from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
-from predictionio_tpu.obs.tracing import span
+from predictionio_tpu.obs.tracing import span, span_histogram
+from predictionio_tpu.ops.bucketing import bucket_size, padding_waste
 from predictionio_tpu.server.plugins import PluginContext
 from predictionio_tpu.storage.base import EngineInstance, generate_id
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.utils.server_config import ServingConfig
 
 logger = logging.getLogger("pio.queryserver")
 
 DEFAULT_PORT = 8000
+
+#: ceiling of the ADAPTIVE linger window (`ServingConfig.batch_linger_s
+#: = None`): the batcher never waits longer than this for stragglers,
+#: and usually waits far less (2x the arrival-interval EWMA)
+ADAPTIVE_LINGER_MAX_S = 0.002
+#: EWMA smoothing for the arrival-interval estimate
+_EWMA_ALPHA = 0.2
+#: an arrival gap above this resets the estimator — idle-period gaps
+#: describe nothing about burst spacing
+_EWMA_RESET_S = 1.0
+
+
+@contextlib.contextmanager
+def _stage(hist, name: str):
+    """Stage timing against a PRE-RESOLVED span histogram handle —
+    `span(..., registry=...)` would re-resolve the histogram under the
+    registry lock on every exit, which has no place on the hot path
+    (the tracing.Trace.span_hist rule)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        hist.observe(time.perf_counter() - t0, span=name)
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -81,62 +109,203 @@ class MicroBatcher:
 
     The reference answers queries in a serial per-request loop
     (CreateServer.scala:508, marked "TODO: Parallelize"). Here every request
-    queued while the previous batch was on the device is drained into ONE
+    queued while a batch is on the device is drained into ONE
     `Algorithm.batch_predict` call per algorithm — for vectorized algorithms
     (e.g. ALS recommend_batch) B concurrent queries cost one [B,K]@[K,N]
     matmul instead of B matvecs.
+
+    Three serving-hot-path mechanisms beyond plain coalescing:
+
+    * **pipelining** — up to `inflight` batches run concurrently on a
+      dedicated bounded executor, so the worker assembles/supplements
+      batch k+1 on the host while batch k is on the device (the classic
+      host/device overlap; `inflight=1` restores strict serialization).
+    * **adaptive linger** (`linger_s=None`) — the wait-for-stragglers
+      window is derived from the arrival-interval EWMA: the worker
+      lingers only when another batch is already in flight (the device
+      is busy, so waiting is free) AND the EWMA says a second request is
+      likely to arrive within ADAPTIVE_LINGER_MAX_S. A lone sequential
+      client therefore never pays a linger tax, while a concurrent burst
+      coalesces. An explicit `linger_s` number forces a fixed wait
+      (0 disables lingering).
+    * **shape bucketing** — not here but in the `predict_batch` callable
+      (`QueryServer._predict_batch` pads each drained batch up to its
+      power-of-two bucket via ops/bucketing before any jitted scorer
+      sees it).
     """
 
     def __init__(self, predict_batch, max_batch: int = 64,
-                 linger_s: float = 0.0):
+                 linger_s: Optional[float] = None, inflight: int = 2,
+                 executor: Optional[ThreadPoolExecutor] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self._predict_batch = predict_batch
-        self.max_batch = max_batch
+        self.max_batch = max(1, max_batch)
+        #: None = adaptive (EWMA-derived); a number = fixed linger window
         self.linger_s = linger_s
+        self.adaptive_linger_max_s = ADAPTIVE_LINGER_MAX_S
+        self.inflight = max(1, inflight)
+        self._executor = executor
         self._queue: Optional[asyncio.Queue] = None
         self._task: Optional[asyncio.Task] = None
+        self._sem: Optional[asyncio.Semaphore] = None
+        self._inflight_now = 0
+        self._ewma_interval: Optional[float] = None
+        self._last_arrival: Optional[float] = None
+        self._size_hist = self._inflight_gauge = self._span_hist = None
+        if registry is not None:
+            self._size_hist = registry.histogram(
+                "pio_batch_size",
+                "Queries coalesced per micro-batch drain",
+                buckets=tuple(float(1 << i) for i in range(11)))
+            self._inflight_gauge = registry.gauge(
+                "pio_batch_inflight",
+                "Micro-batches currently running on the predict executor")
+            registry.gauge_callback(
+                "pio_batch_queue_depth",
+                "Queries waiting in the micro-batch queue",
+                lambda: float(self.queue_depth()))
+            self._span_hist = span_histogram(registry)
 
+    # -- arrival-rate estimate (adaptive linger input) -----------------------
+    def _note_arrival(self) -> None:
+        now = time.monotonic()
+        last, self._last_arrival = self._last_arrival, now
+        if last is None:
+            return
+        dt = now - last
+        if dt > _EWMA_RESET_S:
+            # an idle gap says nothing about spacing WITHIN a burst
+            self._ewma_interval = None
+        elif self._ewma_interval is None:
+            self._ewma_interval = dt
+        else:
+            self._ewma_interval += _EWMA_ALPHA * (dt - self._ewma_interval)
+
+    def _linger_window(self) -> float:
+        if self.linger_s is not None:
+            return self.linger_s
+        if self._inflight_now == 0:
+            # device idle: dispatching now beats betting on a straggler
+            return 0.0
+        ewma = self._ewma_interval
+        if ewma is None or ewma > self.adaptive_linger_max_s:
+            return 0.0
+        return min(self.adaptive_linger_max_s, 2.0 * ewma)
+
+    def _observe_span(self, name: str, seconds: float) -> None:
+        if self._span_hist is not None:
+            self._span_hist.observe(seconds, span=name)
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize() if self._queue is not None else 0
+
+    async def shutdown(self) -> None:
+        """Cancel the worker and wait for its drain to fail everything
+        still queued — handlers see a fast RuntimeError, never a hang.
+        Batches already on the executor resolve through their callbacks."""
+        task = self._task
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            except Exception:      # worker died of its own accord
+                pass
+
+    # -- submit/worker -------------------------------------------------------
     async def submit(self, query):
         loop = asyncio.get_running_loop()
-        if self._task is None or self._task.done():
-            self._queue = asyncio.Queue()
-            self._task = loop.create_task(self._worker())
+        self._note_arrival()
         fut = loop.create_future()
-        self._queue.put_nowait((query, fut))
-        return await fut
+        entry = (query, fut)
+        while True:
+            if self._task is None or self._task.done():
+                self._queue = asyncio.Queue()
+                self._sem = asyncio.Semaphore(self.inflight)
+                self._task = loop.create_task(
+                    self._worker(self._queue, self._sem))
+            task, queue = self._task, self._queue
+            queue.put_nowait(entry)
+            if not task.done() or fut.done():
+                return await fut
+            # the worker completed between the liveness check and the put
+            # — its shutdown drain can have run BEFORE our entry landed,
+            # which would orphan `fut` and hang this handler forever on
+            # `await fut`. Re-check and requeue onto a fresh worker (the
+            # dead queue is abandoned; nothing reads it again).
 
-    async def _worker(self):
+    async def _worker(self, queue: asyncio.Queue, sem: asyncio.Semaphore):
         loop = asyncio.get_running_loop()
         batch = []
         try:
             while True:
-                batch = [await self._queue.get()]
-                if self.linger_s:
-                    await asyncio.sleep(self.linger_s)
-                while len(batch) < self.max_batch and not self._queue.empty():
-                    batch.append(self._queue.get_nowait())
-                queries = [q for q, _ in batch]
+                batch = [await queue.get()]
+                # take an in-flight slot BEFORE assembling: while every
+                # slot is busy the queue keeps filling, which IS the
+                # batching signal — no linger needed under saturation
+                await sem.acquire()
+                dispatched = False
                 try:
-                    results = await loop.run_in_executor(
-                        None, self._predict_batch, queries)
-                except Exception as e:
-                    results = [e] * len(batch)
-                for (_, fut), res in zip(batch, results):
-                    if fut.done():
-                        continue
-                    if isinstance(res, Exception):
-                        fut.set_exception(res)
-                    else:
-                        fut.set_result(res)
+                    while len(batch) < self.max_batch and not queue.empty():
+                        batch.append(queue.get_nowait())
+                    linger = self._linger_window()
+                    if linger > 0.0 and len(batch) < self.max_batch:
+                        t0 = time.perf_counter()
+                        await asyncio.sleep(linger)
+                        self._observe_span("batch_linger",
+                                           time.perf_counter() - t0)
+                        while (len(batch) < self.max_batch
+                               and not queue.empty()):
+                            batch.append(queue.get_nowait())
+                    if self._size_hist is not None:
+                        self._size_hist.observe(float(len(batch)))
+                    queries = [q for q, _ in batch]
+                    ex_fut = loop.run_in_executor(
+                        self._executor, self._predict_batch, queries)
+                    self._inflight_now += 1
+                    if self._inflight_gauge is not None:
+                        self._inflight_gauge.set(float(self._inflight_now))
+                    ex_fut.add_done_callback(
+                        functools.partial(self._finish_batch, batch, sem))
+                    dispatched = True
+                finally:
+                    if not dispatched:
+                        sem.release()
                 batch = []
         finally:
             # worker died (cancellation at shutdown, BaseException): fail
-            # everything in flight so no HTTP handler hangs on `await fut`
-            while not self._queue.empty():
-                batch.append(self._queue.get_nowait())
+            # everything not yet dispatched so no HTTP handler hangs on
+            # `await fut`; already-dispatched batches resolve through
+            # their executor-future callbacks
+            while not queue.empty():
+                batch.append(queue.get_nowait())
             for _, fut in batch:
                 if not fut.done():
                     fut.set_exception(
                         RuntimeError("query micro-batch worker stopped"))
+
+    def _finish_batch(self, batch, sem: asyncio.Semaphore, ex_fut) -> None:
+        """Runs on the event loop when a dispatched batch's executor
+        future settles: free the in-flight slot, then route per-query
+        results/errors to their awaiting handlers."""
+        self._inflight_now -= 1
+        if self._inflight_gauge is not None:
+            self._inflight_gauge.set(float(self._inflight_now))
+        sem.release()
+        try:
+            results = ex_fut.result()
+        except BaseException as e:   # noqa: BLE001 — must never orphan futs
+            err = e if isinstance(e, Exception) else \
+                RuntimeError(f"micro-batch dispatch failed: {e!r}")
+            results = [err] * len(batch)
+        for (_, fut), res in zip(batch, results):
+            if fut.done():
+                continue
+            if isinstance(res, Exception):
+                fut.set_exception(res)
+            else:
+                fut.set_result(res)
 
 
 class QueryServer:
@@ -148,7 +317,8 @@ class QueryServer:
                  plugin_context: Optional[PluginContext] = None,
                  log_url: Optional[str] = None,
                  log_prefix: str = "",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 serving_config: Optional[ServingConfig] = None):
         self.engine = engine
         self.result = train_result
         self.instance = instance
@@ -172,9 +342,36 @@ class QueryServer:
         self.start_time = _dt.datetime.now(tz=UTC)
         self.last_serving_sec = 0.0
         self._stop_event = asyncio.Event()
-        self.batcher = MicroBatcher(self._predict_batch)
         self.registry = registry or MetricsRegistry()
         register_jax_metrics(default_registry())
+        self.serving_config = serving_config or ServingConfig.from_env()
+        #: dedicated bounded pool for predictions ONLY — feedback writes
+        #: and remote logging stay on the loop's default executor, so a
+        #: burst of event-store writes can never starve the hot path (and
+        #: vice versa). Sized past `batch_inflight` so non-vectorized
+        #: engines (per-request path) still get some parallelism.
+        self._predict_executor = ThreadPoolExecutor(
+            max_workers=max(4, self.serving_config.batch_inflight * 2),
+            thread_name_prefix="pio-predict")
+        self.batcher = MicroBatcher(
+            self._predict_batch,
+            max_batch=self.serving_config.batch_max,
+            linger_s=self.serving_config.batch_linger_s,
+            inflight=self.serving_config.batch_inflight,
+            executor=self._predict_executor,
+            registry=self.registry)
+        #: pre-resolved span-histogram handle for batch-stage timings
+        #: (_predict_batch runs per batch on the executor — it must not
+        #: take the registry lock to re-resolve the histogram each stage)
+        self._span_hist = span_histogram(self.registry)
+        self._pad_waste = self.registry.counter(
+            "pio_batch_pad_waste_rows_total",
+            "Throwaway rows added padding batches up to their shape "
+            "bucket (the price of a bounded compile-shape set)")
+        #: cached per TrainResult (recomputing re-imported core.base and
+        #: re-walked every algorithm on EVERY request); refreshed when
+        #: /reload swaps the result
+        self._vectorized_cached = self._compute_vectorized(train_result)
         self._query_hist = self.registry.histogram(
             "pio_query_duration_seconds",
             "Query hot-path wall time by engine variant",
@@ -192,7 +389,15 @@ class QueryServer:
             labelnames=("status",))
         self.app = web.Application(middlewares=[
             observability_middleware(self.registry, "query_server")])
+        self.app.on_cleanup.append(self._on_cleanup)
         self._routes()
+
+    async def _on_cleanup(self, app) -> None:
+        # drain the batcher BEFORE the executor goes away: its worker's
+        # finally fails queued queries fast instead of leaving a pending
+        # task (and a 'Task was destroyed' warning) behind the loop
+        await self.batcher.shutdown()
+        self._predict_executor.shutdown(wait=False)
 
     def _routes(self):
         r = self.app.router
@@ -269,10 +474,11 @@ class QueryServer:
                     prediction = await self.batcher.submit(query)
                 else:
                     # no vectorized batch_predict to exploit — per-request
-                    # thread-pool parallelism beats serializing into one batch
+                    # parallelism on the server's own bounded pool beats
+                    # serializing into one batch
                     loop = asyncio.get_running_loop()
                     prediction = await loop.run_in_executor(
-                        None, self._predict, query)
+                        self._predict_executor, self._predict, query)
         except Exception as e:
             logger.exception("query failed")
             self._query_failures.inc(engine_variant=variant,
@@ -316,16 +522,22 @@ class QueryServer:
         return params_from_json(body, qc)
 
     def _vectorized(self) -> bool:
+        """Cached per TrainResult — the walk itself is cheap but it sat
+        on EVERY request; recomputed only when /reload swaps models."""
+        return self._vectorized_cached
+
+    @staticmethod
+    def _compute_vectorized(result: TrainResult) -> bool:
         """Micro-batching only pays when EVERY algorithm overrides
-        batch_predict with a device-batched implementation — with a mix,
-        the non-vectorized algorithms would run their serial per-query
-        loop inside the single batcher worker, which is slower than the
+        batch_predict with a batched implementation — with a mix, the
+        non-vectorized algorithms would run their serial per-query loop
+        inside the single batcher worker, which is slower than the
         per-request thread-pool path."""
         from predictionio_tpu.core.base import Algorithm
 
-        return bool(self.result.algorithms) and all(
+        return bool(result.algorithms) and all(
             type(a).batch_predict is not Algorithm.batch_predict
-            for a in self.result.algorithms)
+            for a in result.algorithms)
 
     def _predict(self, query):
         supplemented = self.result.serving.supplement(query)
@@ -335,29 +547,61 @@ class QueryServer:
         return self.result.serving.serve(query, predictions)
 
     def _predict_batch(self, queries):
-        """Batch path behind MicroBatcher. Per-query errors are isolated:
-        a failing query yields its Exception in the result slot, never
-        poisoning the rest of the batch."""
+        """Batch path behind MicroBatcher (runs on the predict executor).
+
+        Per-query errors are isolated: a failing query yields its
+        Exception in the result slot, never poisoning the rest of the
+        batch. Before the scorers run, the batch is padded up to its
+        power-of-two shape bucket (ops/bucketing) with clones of the last
+        real query under sentinel indices — jitted scorers therefore see
+        at most `bucket_count(max_batch)` distinct batch shapes ever, and
+        the padded rows are sliced off here so they never reach
+        `serving.serve` or a client.
+
+        This server-level pad is what protects engines whose
+        batch_predict jits on the RAW batch length (classification's
+        `_vector_batch_predict` scores an [B, d] feature matrix through
+        a stable jit). ALS additionally re-buckets on its own device
+        rows (unknown users shrink B mid-model, so it must); for
+        host-BLAS scorers the pad is a few microseconds of duplicated
+        matvec — the bounded price of one rule for every engine."""
         result = self.result      # snapshot: /reload may swap mid-batch
-        out = [None] * len(queries)
+        n = len(queries)
+        out = [None] * n
         ok = []
-        for i, q in enumerate(queries):
-            try:
-                ok.append((i, result.serving.supplement(q)))
-            except Exception as e:
-                out[i] = e
-        if not ok:
-            return out
-        try:
-            per_query = {i: [] for i, _ in ok}
-            for algo, model in zip(result.algorithms, result.models):
-                for i, p in algo.batch_predict(model, ok):
-                    per_query[i].append(p)
-            for i, _ in ok:
+        with _stage(self._span_hist, "batch_assemble"):
+            for i, q in enumerate(queries):
                 try:
-                    out[i] = result.serving.serve(queries[i], per_query[i])
+                    ok.append((i, result.serving.supplement(q)))
                 except Exception as e:
                     out[i] = e
+            if not ok:
+                return out
+            bucket = bucket_size(len(ok), self.batcher.max_batch)
+            waste = padding_waste(len(ok), bucket)
+            if waste:
+                # sentinel indices >= n mark pad rows; their predictions
+                # are computed and thrown away — the bounded price of a
+                # bounded compile-shape set
+                pad_q = ok[-1][1]
+                batch = ok + [(n + j, pad_q) for j in range(waste)]
+                self._pad_waste.inc(waste)
+            else:
+                batch = ok
+        try:
+            per_query = {i: [] for i, _ in ok}
+            with _stage(self._span_hist, "batch_device"):
+                for algo, model in zip(result.algorithms, result.models):
+                    for i, p in algo.batch_predict(model, batch):
+                        if i in per_query:      # pad rows sliced off
+                            per_query[i].append(p)
+            with _stage(self._span_hist, "batch_serve"):
+                for i, _ in ok:
+                    try:
+                        out[i] = result.serving.serve(queries[i],
+                                                      per_query[i])
+                    except Exception as e:
+                        out[i] = e
         except Exception:
             # batch path failed (poison query inside a vectorized
             # batch_predict) — isolate by falling back to per-query predict
@@ -410,8 +654,10 @@ class QueryServer:
         loop = asyncio.get_running_loop()
         result, ctx = await loop.run_in_executor(
             None, load_for_deploy, self.engine, latest)
-        # swap under the running loop — double-buffered reload
+        # swap under the running loop — double-buffered reload; the
+        # cached vectorized-capability flag refreshes with the swap
         self.result = result
+        self._vectorized_cached = self._compute_vectorized(result)
         self.ctx = ctx
         self.instance = latest
         self._reload_total.inc(status="reloaded")
@@ -450,6 +696,8 @@ def run_query_server(engine: Engine, train_result: TrainResult,
     # server.conf key guards /stop and /reload when no explicit key given
     # (CreateServer + KeyAuthentication.scala:33-62)
     kwargs.setdefault("access_key", cfg.key or None)
+    # micro-batch tuning from server.json "serving" + PIO_BATCH_* env
+    kwargs.setdefault("serving_config", cfg.serving)
     server = create_query_server(engine, train_result, instance, ctx, **kwargs)
     ssl_ctx = cfg.ssl_context()
     logger.info("Query server listening on %s:%s%s", ip, port,

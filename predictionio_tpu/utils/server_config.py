@@ -13,10 +13,13 @@ Config file: ``$PIO_CONF_DIR/server.json`` (or the path in
 ``PIO_SERVER_CONF``), JSON shape::
 
     {"key": "<accessKey or empty>",
-     "ssl": {"enabled": false, "certfile": "...", "keyfile": "..."}}
+     "ssl": {"enabled": false, "certfile": "...", "keyfile": "..."},
+     "serving": {"batchMax": 64, "batchLingerS": null, "batchInflight": 2}}
 
 All fields optional; env vars ``PIO_SERVER_KEY`` / ``PIO_SSL_CERTFILE`` /
-``PIO_SSL_KEYFILE`` override file values.
+``PIO_SSL_KEYFILE`` override file values, as do the serving-tuning knobs
+``PIO_BATCH_MAX`` / ``PIO_BATCH_LINGER_S`` / ``PIO_BATCH_INFLIGHT``
+(README "Serving tuning").
 """
 
 from __future__ import annotations
@@ -35,11 +38,62 @@ logger = logging.getLogger("pio.serverconfig")
 
 
 @dataclasses.dataclass
+class ServingConfig:
+    """Query-server micro-batch tuning (the ``PIO_BATCH_*`` knobs).
+
+    ``batch_linger_s = None`` means ADAPTIVE linger: the batcher derives
+    its wait from the observed arrival-rate EWMA and lingers only when a
+    second request is statistically likely to arrive inside the window
+    (server/query_server.MicroBatcher). An explicit number forces a
+    fixed linger; ``0`` disables lingering outright."""
+
+    batch_max: int = 64          # max queries coalesced into one batch
+    batch_linger_s: Optional[float] = None   # None = adaptive EWMA linger
+    batch_inflight: int = 2      # pipelined batches in flight on device
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None) -> "ServingConfig":
+        """Overlay ``PIO_BATCH_*`` env vars on a server.json ``serving``
+        section (camelCase keys, matching the rest of the file). A
+        malformed value — in the file or the env — is logged and falls
+        back to the default; a bad knob must never stop a server from
+        booting."""
+        data = data or {}
+        cfg = cls()
+        sources = (
+            # file first, then env (env wins)
+            ("batchMax", data.get("batchMax"), "batch_max", int),
+            ("batchLingerS", data.get("batchLingerS"), "batch_linger_s",
+             float),
+            ("batchInflight", data.get("batchInflight"), "batch_inflight",
+             int),
+            ("PIO_BATCH_MAX", os.environ.get("PIO_BATCH_MAX"),
+             "batch_max", int),
+            ("PIO_BATCH_LINGER_S", os.environ.get("PIO_BATCH_LINGER_S"),
+             "batch_linger_s", float),
+            ("PIO_BATCH_INFLIGHT", os.environ.get("PIO_BATCH_INFLIGHT"),
+             "batch_inflight", int),
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed serving knob %s=%r",
+                               name, raw)
+        cfg.batch_max = max(1, cfg.batch_max)
+        cfg.batch_inflight = max(1, cfg.batch_inflight)
+        return cfg
+
+
+@dataclasses.dataclass
 class ServerConfig:
     key: str = ""
     ssl_enabled: bool = False
     certfile: Optional[str] = None
     keyfile: Optional[str] = None
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "ServerConfig":
@@ -62,6 +116,7 @@ class ServerConfig:
             ssl_enabled=bool(ssl_conf.get("enabled", False)),
             certfile=ssl_conf.get("certfile"),
             keyfile=ssl_conf.get("keyfile"),
+            serving=ServingConfig.from_env(data.get("serving") or {}),
         )
         if os.environ.get("PIO_SERVER_KEY"):
             cfg.key = os.environ["PIO_SERVER_KEY"]

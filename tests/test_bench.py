@@ -14,11 +14,12 @@ import sys
 BENCH = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
 
 
-def _run(only: str, deadline: str, timeout: int, tmp_path):
+def _run(only: str, deadline: str, timeout: int, tmp_path, extra_env=None):
     env = dict(os.environ)
     env.update({"BENCH_PLATFORM": "cpu", "BENCH_DEADLINE_S": deadline,
                 # keep the repo's committed judged artifact untouched
                 "BENCH_DETAILS_PATH": str(tmp_path / "details.json")})
+    env.update(extra_env or {})
     p = subprocess.run(
         [sys.executable, BENCH, "--only", only],
         capture_output=True, text=True, timeout=timeout, env=env)
@@ -35,6 +36,35 @@ def test_bench_emits_single_json_line(tmp_path):
     assert out["metric"] == "judged_suite_wallclock"
     assert out["value"] > 0
     assert "naive_bayes_spam" in out["unit"]
+
+
+def test_bench_serving_batching_smoke(tmp_path):
+    """Smoke the serving_batching config at a shrunken scale so tier-1
+    exercises the bucketed/pipelined hot path end to end: the config
+    itself asserts the compile-shape bound, and the emitted detail must
+    carry the per-level latency + batch-size fields the judged run
+    records."""
+    p = _run("serving_batching", "300", timeout=280, tmp_path=tmp_path,
+             extra_env={"BENCH_SERVING_QUERIES": "48",
+                        "BENCH_SERVING_CLIENTS": "1,8",
+                        "BENCH_SERVING_USERS": "200",
+                        "BENCH_SERVING_ITEMS": "150"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert "serving_batching" in out["unit"]
+    detail = next(d for d in
+                  json.load(open(tmp_path / "details.json"))["details"]
+                  if d["name"] == "serving_batching")
+    for key in ("p50_ms_1c", "p99_ms_8c", "mean_batch_8c",
+                "p99_ms_8c_single_inflight",
+                "distinct_compiled_batch_shapes", "compile_shape_bound"):
+        assert key in detail, (key, detail)
+    assert 0 < detail["distinct_compiled_batch_shapes"] \
+        <= detail["compile_shape_bound"]
+    # concurrency must actually coalesce: 8 clients -> batches > 1
+    assert detail["mean_batch_8c"] > 1.0
 
 
 def test_bench_survives_wedged_worker_and_reports_partial(tmp_path):

@@ -1,0 +1,458 @@
+"""Serving hot path: bucketed, pipelined micro-batching under load.
+
+Covers the query-server batcher contracts the e2e quickstart test cannot
+(it needs a full train, which shard_map-less jax builds skip): coalescing
+actually batches, per-query error isolation, padded-bucket results exactly
+equal unpadded results, clean drain on shutdown, the submit/worker-death
+requeue, adaptive linger gating, and the bounded compile-shape ledger.
+
+Models are built directly from random factors (no training) so every
+test here is sub-second and hermetic.
+"""
+
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.core.engine import Engine, TrainResult
+from predictionio_tpu.core.params import EngineParams
+from predictionio_tpu.core.base import Algorithm, Serving
+from predictionio_tpu.engines.recommendation import (
+    ALSAlgorithm, AlgorithmParams, RecommendationServing,
+)
+from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.ops import bucketing, fn_cache
+from predictionio_tpu.server.query_server import MicroBatcher, QueryServer
+from predictionio_tpu.storage.base import EngineInstance
+from predictionio_tpu.utils.server_config import ServingConfig
+
+pytestmark = pytest.mark.anyio
+
+N_USERS, N_ITEMS, RANK = 40, 30, 6
+
+
+def make_als_model(seed=0) -> ALSModel:
+    rng = np.random.default_rng(seed)
+    return ALSModel(
+        user_vocab=np.sort(np.asarray(
+            [f"u{i}" for i in range(N_USERS)], dtype=object)),
+        item_vocab=np.sort(np.asarray(
+            [f"i{i}" for i in range(N_ITEMS)], dtype=object)),
+        U=rng.normal(size=(N_USERS, RANK)).astype(np.float32),
+        V=rng.normal(size=(N_ITEMS, RANK)).astype(np.float32))
+
+
+def make_server(algorithms=None, models=None, serving=None,
+                serving_config=None) -> QueryServer:
+    if algorithms is None:
+        algorithms = [ALSAlgorithm(AlgorithmParams())]
+        models = [make_als_model()]
+    result = TrainResult(models=models, algorithms=algorithms,
+                         serving=serving or RecommendationServing(),
+                         engine_params=EngineParams())
+    instance = EngineInstance(id="batcher-test", engine_id="e",
+                              engine_variant="default")
+    engine = Engine({}, {}, {"als": ALSAlgorithm}, {})
+    return QueryServer(engine, result, instance, ctx=None,
+                       serving_config=serving_config)
+
+
+# ---------------------------------------------------------------------------
+# ops/bucketing unit contracts
+# ---------------------------------------------------------------------------
+
+def test_bucket_size_rounds_to_pow2_capped():
+    assert [bucketing.bucket_size(n) for n in (1, 2, 3, 5, 8, 9)] == \
+        [1, 2, 4, 8, 8, 16]
+    assert bucketing.bucket_size(40, cap=64) == 64
+    assert bucketing.bucket_size(40, cap=48) == 48      # cap is terminal
+    assert bucketing.bucket_size(100, cap=64) == 100    # misuse: never shrink
+    assert bucketing.bucket_size(0) == 0
+
+
+def test_bucket_count_bounds_shape_set():
+    # every reachable bucket for cap=64: 1,2,4,8,16,32,64
+    assert bucketing.bucket_count(64) == 7
+    assert bucketing.bucket_count(48) == 7              # ... plus the cap
+    buckets = {bucketing.bucket_size(n, 64) for n in range(1, 65)}
+    assert len(buckets) == bucketing.bucket_count(64)
+
+
+def test_pad_rows_and_waste():
+    rows = np.arange(6, dtype=np.float32).reshape(3, 2)
+    padded = bucketing.pad_rows(rows, 4)
+    assert padded.shape == (4, 2) and (padded[3] == 0).all()
+    assert bucketing.pad_rows(rows, 3) is rows          # no-op at size
+    assert bucketing.padding_waste(3, 8) == 5
+    assert bucketing.padding_waste(0, 8) == 0
+
+
+# ---------------------------------------------------------------------------
+# coalescing + correctness through the HTTP hot path
+# ---------------------------------------------------------------------------
+
+class CountingALS(ALSAlgorithm):
+    """Counts batch_predict calls and the batch sizes it was handed."""
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self.calls = []
+
+    def batch_predict(self, model, queries):
+        self.calls.append(len(queries))
+        return super().batch_predict(model, queries)
+
+
+async def test_concurrent_submits_coalesce_into_one_batch_predict():
+    algo = CountingALS(AlgorithmParams())
+    server = make_server(algorithms=[algo], models=[make_als_model()])
+    server.batcher.linger_s = 0.05   # force coalescing deterministically
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        out = await asyncio.gather(*[
+            c.post("/queries.json", json={"user": f"u{i % 9}", "num": 3})
+            for i in range(12)])
+        for resp in out:
+            assert resp.status == 200
+            assert len((await resp.json())["itemScores"]) == 3
+    finally:
+        await c.close()
+    assert len(algo.calls) == 1, f"expected ONE coalesced call: {algo.calls}"
+    # 12 real queries padded to the 16 bucket before the scorer saw them
+    assert algo.calls[0] == 16
+    assert server._pad_waste.value() == 4.0
+    assert server.registry.get("pio_batch_size").total_count() == 1
+
+
+async def test_padded_bucket_results_exactly_equal_unpadded():
+    server = make_server()
+    queries = [server._extract_query({"user": f"u{i}", "num": 4})
+               for i in range(5)]            # 5 pads to the 8 bucket
+    batched = server._predict_batch(queries)
+    assert server._pad_waste.value() == 3.0
+    for q, got in zip(queries, batched):
+        want = server._predict(q)
+        assert [s.item for s in got.item_scores] == \
+            [s.item for s in want.item_scores]
+        np.testing.assert_allclose(
+            [s.score for s in got.item_scores],
+            [s.score for s in want.item_scores], rtol=1e-5)
+
+
+async def test_per_query_error_isolation_in_batch():
+    from predictionio_tpu.engines.recommendation import Query as RecQuery
+
+    class PoisonALS(ALSAlgorithm):
+        # the un-annotated override would defeat predict-signature query
+        # class resolution (_query_class reads the subclass's hints)
+        query_class = RecQuery
+
+        def predict(self, model, query):
+            if query.user == "poison":
+                raise ValueError("bad query")
+            return super().predict(model, query)
+
+        def batch_predict(self, model, queries):
+            if any(q.user == "poison" for _, q in queries):
+                raise ValueError("bad query in batch")
+            return super().batch_predict(model, queries)
+
+    server = make_server(algorithms=[PoisonALS(AlgorithmParams())],
+                         models=[make_als_model()])
+    queries = [server._extract_query({"user": u, "num": 2})
+               for u in ("u1", "poison", "u2")]
+    out = server._predict_batch(queries)
+    assert isinstance(out[1], Exception)
+    for i in (0, 2):
+        assert [s.item for s in out[i].item_scores] == \
+            [s.item for s in server._predict(queries[i]).item_scores]
+
+
+async def test_supplement_failure_isolated_and_never_padded_in():
+    class FussySupplement(Serving):
+        def supplement(self, query):
+            if query.user == "reject":
+                raise ValueError("unsupplementable")
+            return query
+
+        def serve(self, query, predictions):
+            return predictions[0]
+
+    server = make_server(algorithms=[ALSAlgorithm(AlgorithmParams())],
+                         models=[make_als_model()],
+                         serving=FussySupplement())
+    queries = [server._extract_query({"user": u, "num": 2})
+               for u in ("u1", "reject", "u2")]
+    out = server._predict_batch(queries)
+    assert isinstance(out[1], Exception)
+    assert len(out[0].item_scores) == 2 and len(out[2].item_scores) == 2
+
+
+# ---------------------------------------------------------------------------
+# worker lifecycle: shutdown drain + the submit/death requeue race
+# ---------------------------------------------------------------------------
+
+async def test_clean_drain_on_shutdown():
+    started = asyncio.Event()
+    release = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    def slow_batch(queries):
+        loop.call_soon_threadsafe(started.set)
+        # block the (sole) executor slot until the test releases it
+        fut = asyncio.run_coroutine_threadsafe(release.wait(), loop)
+        fut.result(timeout=5)
+        return ["ok"] * len(queries)
+
+    batcher = MicroBatcher(slow_batch, max_batch=4, linger_s=0.0,
+                           inflight=1)
+    subs = [asyncio.ensure_future(batcher.submit(i)) for i in range(6)]
+    await started.wait()           # batch 1 is on the executor
+    batcher._task.cancel()         # server shutdown
+    release.set()                  # let the in-flight batch finish
+    done = await asyncio.gather(*subs, return_exceptions=True)
+    # the dispatched batch resolves normally; every queued-but-undrained
+    # query fails fast instead of hanging its handler
+    assert "ok" in done
+    rest = [d for d in done if d != "ok"]
+    assert rest and all(isinstance(d, RuntimeError) for d in rest)
+
+
+async def test_submit_recovers_after_worker_death():
+    batcher = MicroBatcher(lambda qs: [q * 2 for q in qs],
+                           max_batch=4, linger_s=0.0, inflight=2)
+    assert await batcher.submit(21) == 42
+    # kill the worker (shutdown, crash, loop teardown mid-flight)
+    batcher._task.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await batcher._task
+    # next submit must detect the dead worker and respawn, not hang or
+    # enqueue onto the dead queue (the orphaned-future bug)
+    assert await asyncio.wait_for(batcher.submit(5), timeout=2) == 10
+
+
+async def test_submit_requeues_when_entry_lands_on_dead_queue():
+    """The exact race: the put lands on a queue whose worker completed —
+    and whose shutdown drain already ran — between the liveness check and
+    the put. submit must detect it on the post-put recheck and requeue
+    onto a fresh worker instead of returning a future nothing will ever
+    resolve (the orphaned-handler hang). The interleaving cannot occur
+    naturally inside one event-loop step, so a scripted Task stand-in
+    plays the dying worker."""
+    batcher = MicroBatcher(lambda qs: [q + 1 for q in qs],
+                           max_batch=4, linger_s=0.0, inflight=1)
+
+    class ZombieTask:
+        """Reports alive at submit's liveness check, dead ever after —
+        its queue is already drained, so anything put there is lost."""
+
+        def __init__(self):
+            self.done_calls = 0
+
+        def done(self):
+            self.done_calls += 1
+            return self.done_calls > 1
+
+    zombie = ZombieTask()
+    abandoned = asyncio.Queue()
+    batcher._task, batcher._queue = zombie, abandoned
+
+    assert await asyncio.wait_for(batcher.submit(7), timeout=2) == 8
+    # the entry DID land on the dead queue first (the lost put) ...
+    assert abandoned.qsize() == 1
+    # ... and submit respawned a real worker that served the requeue
+    assert isinstance(batcher._task, asyncio.Task)
+    assert zombie.done_calls >= 2
+
+
+# ---------------------------------------------------------------------------
+# adaptive linger
+# ---------------------------------------------------------------------------
+
+def test_linger_window_fixed_value_wins():
+    b = MicroBatcher(lambda qs: qs, linger_s=0.25)
+    b._inflight_now = 0
+    assert b._linger_window() == 0.25
+
+
+def test_linger_window_adaptive_gates_on_inflight_and_ewma():
+    b = MicroBatcher(lambda qs: qs, linger_s=None)
+    # device idle -> never wait, a lone client pays no linger tax
+    b._inflight_now, b._ewma_interval = 0, 0.0001
+    assert b._linger_window() == 0.0
+    # busy device + tight arrivals -> linger, bounded by the cap
+    b._inflight_now = 1
+    assert 0.0 < b._linger_window() <= b.adaptive_linger_max_s
+    b._ewma_interval = 0.0001
+    assert b._linger_window() == pytest.approx(0.0002)
+    # arrivals sparser than the window -> a second request is unlikely
+    b._ewma_interval = 10 * b.adaptive_linger_max_s
+    assert b._linger_window() == 0.0
+    # no estimate yet -> no bet
+    b._ewma_interval = None
+    assert b._linger_window() == 0.0
+
+
+def test_arrival_ewma_tracks_and_resets():
+    import time as _time
+
+    b = MicroBatcher(lambda qs: qs)
+    b._note_arrival()
+    assert b._ewma_interval is None          # one sample = no interval
+    b._last_arrival = _time.monotonic() - 0.001
+    b._note_arrival()
+    assert 0.0 < b._ewma_interval < 0.1
+    # a long idle gap resets the estimator instead of polluting it
+    b._last_arrival = _time.monotonic() - 30.0
+    b._note_arrival()
+    assert b._ewma_interval is None
+
+
+# ---------------------------------------------------------------------------
+# vectorized-capability cache + serving config
+# ---------------------------------------------------------------------------
+
+class NotVectorized(Algorithm):
+    def train(self, ctx, prepared_data):
+        return None
+
+    def predict(self, model, query):
+        return {"ok": True}
+
+
+async def test_vectorized_flag_cached_per_train_result():
+    server = make_server()
+    assert server._vectorized() is True
+    # mutating the live result does NOT re-walk algorithms per request...
+    server.result.algorithms.append(NotVectorized())
+    assert server._vectorized() is True
+    # ...the flag refreshes only with an explicit swap (the /reload path)
+    server._vectorized_cached = server._compute_vectorized(server.result)
+    assert server._vectorized() is False
+
+
+def test_serving_config_env_overrides(monkeypatch):
+    monkeypatch.setenv("PIO_BATCH_MAX", "128")
+    monkeypatch.setenv("PIO_BATCH_LINGER_S", "0.01")
+    monkeypatch.setenv("PIO_BATCH_INFLIGHT", "3")
+    cfg = ServingConfig.from_env({"batchMax": 16, "batchInflight": 1})
+    assert (cfg.batch_max, cfg.batch_linger_s, cfg.batch_inflight) == \
+        (128, 0.01, 3)
+    monkeypatch.delenv("PIO_BATCH_LINGER_S")
+    cfg = ServingConfig.from_env({"batchMax": 16})
+    assert cfg.batch_max == 128          # env beats file
+    assert cfg.batch_linger_s is None    # default: adaptive
+    monkeypatch.setenv("PIO_BATCH_MAX", "garbage")
+    assert ServingConfig.from_env().batch_max == 64   # malformed -> default
+
+
+async def test_server_config_wires_batcher(monkeypatch):
+    monkeypatch.setenv("PIO_BATCH_MAX", "32")
+    monkeypatch.setenv("PIO_BATCH_INFLIGHT", "1")
+    server = make_server()
+    assert server.batcher.max_batch == 32
+    assert server.batcher.inflight == 1
+
+
+# ---------------------------------------------------------------------------
+# similarproduct batch scorers (multi-algo engines ride the batched path)
+# ---------------------------------------------------------------------------
+
+def make_similarity_model(seed=1):
+    from predictionio_tpu.engines.common import Item
+    from predictionio_tpu.engines.similarproduct import SimilarityModel
+
+    rng = np.random.default_rng(seed)
+    V = rng.normal(size=(N_ITEMS, RANK)).astype(np.float32)
+    V /= np.linalg.norm(V, axis=1, keepdims=True)
+    vocab = np.sort(np.asarray([f"i{i}" for i in range(N_ITEMS)],
+                               dtype=object))
+    items = {i: Item(categories=None) for i in range(N_ITEMS)}
+    return SimilarityModel(item_vocab=vocab, V=V, items=items)
+
+
+def test_similarproduct_als_batch_matches_serial():
+    from predictionio_tpu.engines.similarproduct import (
+        ALSAlgorithm as SPAls, Query as SPQuery)
+
+    model = make_similarity_model()
+    algo = SPAls()
+    queries = [
+        SPQuery(items=("i1",), num=4),
+        SPQuery(items=("i2", "i5"), num=3, black_list=("i7",)),
+        SPQuery(items=("unknown",), num=3),          # -> empty, isolated
+        SPQuery(items=("i3",), num=5, white_list=("i0", "i4", "i6")),
+    ]
+    serial = [algo.predict(model, q) for q in queries]
+    batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+    for i, want in enumerate(serial):
+        got = batched[i]
+        assert [s.item for s in got.item_scores] == \
+            [s.item for s in want.item_scores]
+        np.testing.assert_allclose(
+            [s.score for s in got.item_scores],
+            [s.score for s in want.item_scores], rtol=1e-5)
+    assert batched[2].item_scores == []
+
+
+def test_similarproduct_engine_is_vectorized_for_batching():
+    """All three similarproduct algorithms override batch_predict, so the
+    query server routes the multi-algo engine through the micro-batcher."""
+    from predictionio_tpu.engines.similarproduct import (
+        ALSAlgorithm as SPAls, CooccurrenceAlgorithm, LikeAlgorithm)
+
+    result = TrainResult(
+        models=[None, None, None],
+        algorithms=[SPAls(), CooccurrenceAlgorithm(), LikeAlgorithm()],
+        serving=RecommendationServing(), engine_params=EngineParams())
+    assert QueryServer._compute_vectorized(result) is True
+
+
+def test_cooccurrence_batch_matches_serial():
+    from predictionio_tpu.engines.common import Item
+    from predictionio_tpu.engines.similarproduct import (
+        CooccurrenceAlgorithm, CooccurrenceEngineModel, Query as SPQuery)
+    from predictionio_tpu.models.cooccurrence import CooccurrenceModel
+
+    vocab = np.asarray(["a", "b", "c", "d"], dtype=object)
+    inner = CooccurrenceModel(
+        item_vocab=vocab,
+        top_cooccurrences={0: [(1, 5), (2, 2)], 1: [(0, 5)],
+                           2: [(0, 2), (3, 1)]})
+    model = CooccurrenceEngineModel(
+        model=inner, items={i: Item(categories=None) for i in range(4)})
+    algo = CooccurrenceAlgorithm()
+    queries = [SPQuery(items=("a",), num=3), SPQuery(items=("c", "b"), num=2)]
+    serial = [algo.predict(model, q) for q in queries]
+    batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+    for i, want in enumerate(serial):
+        assert [(s.item, s.score) for s in batched[i].item_scores] == \
+            [(s.item, s.score) for s in want.item_scores]
+
+
+# ---------------------------------------------------------------------------
+# compile-shape ledger: bucketed batches keep the jit cache bounded
+# ---------------------------------------------------------------------------
+
+async def test_compile_shapes_bounded_under_varied_batch_sizes():
+    import predictionio_tpu.models.als as als_mod
+
+    model = make_als_model(seed=3)
+    old = als_mod._DEVICE_ROUNDTRIP_S
+    als_mod._DEVICE_ROUNDTRIP_S = 0.0    # force the jitted device scorer
+    try:
+        for b in (1, 2, 3, 5, 6, 7, 9, 12, 15, 16):
+            reqs = [(f"u{i % N_USERS}", 4, (), None) for i in range(b)]
+            out = model.recommend_batch(reqs)
+            assert all(len(r) == 4 for r in out)
+    finally:
+        als_mod._DEVICE_ROUNDTRIP_S = old
+    keys = [k for k in fn_cache.family_keys("als_topk")
+            if k[2:] == (N_ITEMS, RANK)]
+    # 10 distinct drained sizes <= 64 must collapse into the bucket set
+    assert 0 < len(keys) <= bucketing.bucket_count(64)
+    assert {k[0] for k in keys} <= {1, 2, 4, 8, 16, 32, 64}

@@ -956,6 +956,175 @@ def cfg_serving_batching(jax, mesh, platform):
     return detail
 
 
+def cfg_train_ingest(jax, mesh, platform):
+    """Training-ingest hot path: event store -> model-ready arrays, the
+    old per-Event fold vs the columnar pipeline (find_columnar +
+    vectorized aggregate/intern, data/ingest.py), swept over event
+    counts (BENCH_INGEST_EVENTS). Reports rows/s for both paths plus the
+    snapshot-digest cache-hit replay time. No device math — this measures
+    the host-side layer between storage and XLA that used to dominate
+    `pio train` (SURVEY §2.9 P2; the ALX flat-array ingest argument)."""
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.data import DataMap, Event
+    from predictionio_tpu.data.aggregator import (
+        aggregate_properties as row_aggregate,
+    )
+    from predictionio_tpu.data.bimap import BiMap, assign_indices
+    from predictionio_tpu.data.eventstore import EventStoreClient, clear_cache
+    from predictionio_tpu.data.ingest import (
+        event_columns, pair_counts, training_scan,
+    )
+    from predictionio_tpu.storage import App, Storage
+
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_INGEST_EVENTS", "20000,100000").split(",")]
+    backends = os.environ.get(
+        "BENCH_INGEST_BACKENDS", "parquet,sqlite").split(",")
+    n_users, n_items = 2000, 500
+    detail = {"sizes": sizes, "backends": backends}
+    total_t0 = time.perf_counter()
+    import datetime as dt
+
+    UTC = dt.timezone.utc
+
+    def seed_store(root, n, backend):
+        if backend == "parquet":
+            sources = {
+                "DB": {"TYPE": "sqlite", "PATH": f"{root}/meta.db"},
+                "PQ": {"TYPE": "parquet", "PATH": f"{root}/events"},
+            }
+            repos = {"METADATA": {"NAME": "pio", "SOURCE": "DB"},
+                     "EVENTDATA": {"NAME": "pio", "SOURCE": "PQ"},
+                     "MODELDATA": {"NAME": "pio", "SOURCE": "DB"}}
+        else:
+            sources = {"DB": {"TYPE": "sqlite",
+                              "PATH": f"{root}/bench_ingest.db"}}
+            repos = {r: {"NAME": "pio", "SOURCE": "DB"}
+                     for r in ("METADATA", "EVENTDATA", "MODELDATA")}
+        Storage.configure({"sources": sources, "repositories": repos})
+        clear_cache()
+        app_id = Storage.get_meta_data_apps().insert(
+            App(id=0, name="BenchIngest"))
+        store = Storage.get_events()
+        store.init_channel(app_id)
+        rng = np.random.default_rng(7)
+        events = []
+        t = 0
+        for u in range(n_users):
+            events.append(Event(
+                event="$set", entity_type="user", entity_id=f"u{u}",
+                properties=DataMap({"segment": int(u % 5)}),
+                event_time=dt.datetime.fromtimestamp(
+                    (t := t + 1) / 1000, tz=UTC)))
+        ev_names = np.asarray(["rate", "buy"])[
+            (rng.random(n) < 0.3).astype(np.int8)]
+        us = rng.integers(0, n_users, n)
+        its = rng.integers(0, n_items, n)
+        rat = rng.integers(1, 6, n)
+        for k in range(n):
+            name = str(ev_names[k])
+            events.append(Event(
+                event=name, entity_type="user", entity_id=f"u{us[k]}",
+                target_entity_type="item", target_entity_id=f"i{its[k]}",
+                properties=(DataMap({"rating": float(rat[k])})
+                            if name == "rate" else DataMap()),
+                event_time=dt.datetime.fromtimestamp(
+                    (t := t + 1) / 1000, tz=UTC)))
+            if len(events) >= 10_000:
+                store.insert_batch(events, app_id)
+                events = []
+        if events:
+            store.insert_batch(events, app_id)
+
+    def per_event_read():
+        """The pre-columnar training read: per-Event iteration, python
+        rating fold, dict-intern (collect + BiMap), row aggregate."""
+        ratings = []
+        for e in EventStoreClient.find(
+                app_name="BenchIngest", entity_type="user",
+                event_names=["rate", "buy"], target_entity_type="item"):
+            v = (float(e.properties.get("rating")) if e.event == "rate"
+                 else 4.0)
+            ratings.append((e.entity_id, e.target_entity_id, v))
+        u_map = BiMap.string_int(r[0] for r in ratings)
+        i_map = BiMap.string_int(r[1] for r in ratings)
+        u_codes = np.fromiter((u_map[r[0]] for r in ratings), np.int32,
+                              len(ratings))
+        i_codes = np.fromiter((i_map[r[1]] for r in ratings), np.int32,
+                              len(ratings))
+        users = row_aggregate(EventStoreClient.find(
+            app_name="BenchIngest", entity_type="user",
+            event_names=["$set", "$unset", "$delete"]))
+        return len(ratings) + len(users), u_codes, i_codes
+
+    def columnar_read(cache=False):
+        """The columnar pipeline: one arrow scan, vectorized value fill,
+        np.unique intern, columnar $set fold."""
+        from predictionio_tpu.data.columnar import property_column
+
+        scan = training_scan(
+            "BenchIngest", entity_type="user",
+            event_names=["rate", "buy"], target_entity_type="item",
+            cache=cache,
+            columns=("event", "entity_id", "target_entity_id",
+                     "properties"))
+        events, users, items = event_columns(
+            scan.table, "event", "entity_id", "target_entity_id")
+        is_rate = events == "rate"
+        values = np.full(len(events), 4.0, np.float32)
+        if is_rate.any():
+            import pyarrow as pa
+
+            values[is_rate] = property_column(
+                scan.table.filter(pa.array(is_rate)), "rating")
+        _, u_codes = assign_indices(users)
+        _, i_codes = assign_indices(items)
+        props = EventStoreClient.aggregate_properties("BenchIngest", "user")
+        return len(values) + len(props), u_codes, i_codes
+
+    for backend in backends:
+        for n in sizes:
+            root = tempfile.mkdtemp(prefix="pio_bench_ingest_")
+            try:
+                hb(f"train_ingest seed {backend} {n}")
+                seed_store(root, n, backend)
+                hb(f"train_ingest per-event {backend} {n}")
+                # same best-of-3 discipline as the columnar side, so a
+                # stray stall can never inflate the reported speedup
+                pe_s, (rows_pe, upe, ipe) = timed_best(per_event_read)
+                hb(f"train_ingest columnar {backend} {n}")
+                col_s, (rows_col, uc, ic) = timed_best(
+                    lambda: columnar_read(cache=False))
+                # parity: both paths interned the identical code streams
+                assert rows_col == rows_pe and np.array_equal(upe, uc) \
+                    and np.array_equal(ipe, ic), "ingest paths disagree"
+                columnar_read(cache=True)      # prime the digest cache
+                hit_s, _ = timed_best(lambda: columnar_read(cache=True))
+                k = f"{backend}_{n}"
+                detail[f"rows_per_s_per_event_{k}"] = round(rows_pe / pe_s)
+                detail[f"rows_per_s_columnar_{k}"] = round(rows_col / col_s)
+                detail[f"speedup_{k}"] = round(pe_s / col_s, 2)
+                detail[f"cache_hit_s_{k}"] = round(hit_s, 4)
+            finally:
+                Storage.reset()
+                clear_cache()
+                shutil.rmtree(root, ignore_errors=True)
+    top = f"{backends[0]}_{sizes[-1]}"
+    detail["elapsed_s"] = round(time.perf_counter() - total_t0, 2)
+    detail["speedup_headline"] = detail[f"speedup_{top}"]
+    detail["note"] = (
+        f"columnar ingest {detail[f'speedup_{top}']}x per-event on "
+        f"{backends[0]} at {sizes[-1]} events "
+        f"({detail[f'rows_per_s_columnar_{top}']} vs "
+        f"{detail[f'rows_per_s_per_event_{top}']} rows/s); cache-hit "
+        f"replay {detail[f'cache_hit_s_{top}']}s; "
+        + "; ".join(f"{b}: {detail[f'speedup_{b}_{sizes[-1]}']}x"
+                    for b in backends))
+    return detail
+
+
 def cfg_sleep_forever(jax, mesh, platform):
     """Test-only config (never in the default set): wedges the worker so
     the orchestrator's watchdog + ladder can be exercised on CPU."""
@@ -973,6 +1142,7 @@ CONFIGS = {
     "ecommerce_implicit_als": (cfg_ecommerce, 240),
     "eval_sweep_3fold_3rank": (cfg_eval_sweep, 420),
     "serving_batching": (cfg_serving_batching, 240),
+    "train_ingest": (cfg_train_ingest, 240),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
 
@@ -1269,13 +1439,13 @@ class Suite:
 
 
 def orchestrate(names, partial=False):
-    # default covers the summed per-config budgets (2880s) PLUS worker
+    # default covers the summed per-config budgets (3120s) PLUS worker
     # init (INIT_BUDGET_S=420, possibly retried) so the tail config
     # (als_ml20m, the north star) is not skipped as "suite deadline" on a
     # slow-but-healthy chip; a pathologically slow claim + retry can still
     # eat into the tail, and if an outer driver timeout fires first the
     # SIGTERM handler dumps partials
-    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 3540))
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 3780))
     suite = Suite(names, deadline_s, partial=partial)
 
     def _sigterm(_sig, _frm):

@@ -8,6 +8,7 @@ property aggregation, and bidirectional id maps for string->index assignment.
 from predictionio_tpu.data.datamap import DataMap, DataMapError, PropertyMap
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event
 from predictionio_tpu.data.aggregator import aggregate_properties, aggregate_properties_single
+from predictionio_tpu.data.columnar import aggregate_properties_table
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.entity_map import EntityMap
 
@@ -21,5 +22,6 @@ __all__ = [
     "validate_event",
     "aggregate_properties",
     "aggregate_properties_single",
+    "aggregate_properties_table",
     "BiMap",
 ]

@@ -15,6 +15,7 @@ from typing import Iterable, Iterator
 import numpy as np
 import pyarrow as pa
 
+from predictionio_tpu.data.aggregator import AGGREGATOR_EVENT_NAMES
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import UTC, Event, millis
 
@@ -31,23 +32,39 @@ EVENT_SCHEMA = pa.schema([
 ])
 
 
-def rows_to_event_table(rows) -> pa.Table:
-    """SQL result rows (9 columns in EVENT_SCHEMA order: id, event,
-    entityType, entityId, targetEntityType, targetEntityId, properties,
-    eventTime, creationTime) -> the shared columnar layout. One builder
-    for every SQL backend's `find_columnar` so the schema can never
-    drift between them."""
+#: EVENT_SCHEMA name -> the SQL backends' physical column (shared by
+#: sqlite/postgres `find_columnar` so projections cannot drift)
+SQL_COLUMN_OF = {
+    "event_id": "id", "event": "event", "entity_type": "entityType",
+    "entity_id": "entityId", "target_entity_type": "targetEntityType",
+    "target_entity_id": "targetEntityId", "properties": "properties",
+    "event_time_ms": "eventTime", "creation_time_ms": "creationTime",
+}
+
+
+def projected_schema(names=None) -> pa.Schema:
+    """EVENT_SCHEMA restricted to `names` (order preserved); the full
+    schema when None. Unknown names raise KeyError early."""
+    if names is None:
+        return EVENT_SCHEMA
+    return pa.schema([EVENT_SCHEMA.field(n) for n in names])
+
+
+def rows_to_event_table(rows, names=None) -> pa.Table:
+    """SQL result rows -> the shared columnar layout. `names` is the
+    projection the rows were SELECTed with (EVENT_SCHEMA order: id,
+    event, entityType, entityId, targetEntityType, targetEntityId,
+    properties, eventTime, creationTime), defaulting to all nine. One
+    builder for every SQL backend's `find_columnar` so the schema can
+    never drift between them."""
+    schema = projected_schema(names)
     if not rows:
-        return pa.table({n: [] for n in EVENT_SCHEMA.names},
-                        schema=EVENT_SCHEMA)
+        return pa.table({n: [] for n in schema.names}, schema=schema)
     c = list(zip(*rows))
-    return pa.table({
-        "event_id": c[0], "event": c[1], "entity_type": c[2],
-        "entity_id": c[3], "target_entity_type": c[4],
-        "target_entity_id": c[5],
-        "properties": [p if p else None for p in c[6]],
-        "event_time_ms": c[7], "creation_time_ms": c[8],
-    }, schema=EVENT_SCHEMA)
+    data = {n: c[i] for i, n in enumerate(schema.names)}
+    if "properties" in data:
+        data["properties"] = [p if p else None for p in data["properties"]]
+    return pa.table(data, schema=schema)
 
 
 def events_to_table(events: Iterable[Event]) -> pa.Table:
@@ -85,15 +102,168 @@ def table_to_events(table: pa.Table) -> Iterator[Event]:
         )
 
 
-def property_column(table: pa.Table, key: str, dtype=np.float32) -> np.ndarray:
-    """Extract one numeric property from the JSON properties column."""
-    out = np.empty(table.num_rows, dtype=dtype)
+def string_column(table: pa.Table, name: str) -> np.ndarray:
+    """One string column as a NumPy object array, decoded through Arrow's
+    hash-based dictionary encode: one Python string per DISTINCT value,
+    then a vectorized ``vocab[codes]`` gather of shared references —
+    O(distinct) object churn instead of O(rows). Nulls decode to None."""
+    import pyarrow.compute as pc
+
+    if table.num_rows == 0:
+        return np.empty(0, dtype=object)
+    enc = table.column(name).combine_chunks().dictionary_encode()
+    vocab = np.asarray(enc.dictionary.to_pylist() + [None], dtype=object)
+    idx = np.asarray(
+        pc.fill_null(enc.indices, len(vocab) - 1)
+        .to_numpy(zero_copy_only=False), dtype=np.int64)
+    return vocab[idx]
+
+
+def aggregate_properties_table(table: pa.Table, required=None):
+    """Vectorized `$set/$unset/$delete` fold over a columnar event scan.
+
+    Same semantics as the per-event fold (data/aggregator.py, the
+    LEventAggregator parity contract) but computed with sort + last-wins
+    segment ops on flat arrays instead of materializing an Event object
+    per row:
+
+      1. one stable lexsort puts every entity's special events in time
+         order (ties keep scan order, like the row fold's stable sort);
+      2. `$delete` precedence is a per-entity max-scan: rows at or before
+         the segment's LAST delete can never contribute fields;
+      3. field resolution is last-wins per (entity, key): flatten the
+         surviving rows' parsed keys, lexsort by (entity, key, position),
+         keep each group's final op, and keep the key iff that op is a
+         `$set`;
+      4. first/last updated are the segment's time extrema over ALL
+         special rows (pre-delete rows still advance the clock, matching
+         `_Fold.step`).
+
+    Only `json.loads` per surviving row and the final per-entity dict
+    assembly stay on the Python side; everything positional is NumPy.
+    Returns ``{entity_id: PropertyMap}`` with UTC times (datetime
+    equality is instant-based, so this matches the row path's
+    zone-restoring reads).
+
+    `required` filters the result to entities carrying every named field
+    (PEventStore.aggregateProperties `required` parity).
+    """
+    import datetime as dt
+
+    from predictionio_tpu.data.bimap import assign_indices
+    from predictionio_tpu.data.datamap import PropertyMap
+
+    if table.num_rows == 0:
+        return {}
+    events = string_column(table, "event")
+    special = np.isin(events, np.asarray(AGGREGATOR_EVENT_NAMES, dtype=object))
+    if not special.all():
+        table = table.filter(pa.array(special))
+        if table.num_rows == 0:
+            return {}
+        events = events[special]
+    entity_ids = string_column(table, "entity_id")
+    times = np.asarray(
+        table.column("event_time_ms").to_numpy(zero_copy_only=False),
+        dtype=np.int64)
     props = table.column("properties").to_pylist()
-    for i, p in enumerate(props):
-        if p is None:
-            out[i] = np.nan
-        else:
-            out[i] = json.loads(p).get(key, np.nan)
+
+    vocab, codes = assign_indices(entity_ids)
+    n = len(codes)
+    # stable (entity, time) order; the trailing arange keeps scan order
+    # for equal timestamps (sorted() stability in the row fold)
+    order = np.lexsort((np.arange(n), times, codes))
+    codes_s, times_s = codes[order], times[order]
+    events_s = events[order]
+
+    starts = np.flatnonzero(np.r_[True, codes_s[1:] != codes_s[:-1]])
+    seg_of = np.repeat(np.arange(len(starts)),
+                       np.diff(np.r_[starts, n]))
+    seg_entity = vocab[codes_s[starts]]
+
+    # time extrema per segment (sorted by time -> first/last element)
+    first_ms = times_s[starts]
+    last_ms = times_s[np.r_[starts[1:] - 1, n - 1]]
+
+    # rows at or before each segment's last $delete are dead
+    pos = np.arange(n)
+    is_delete = events_s == "$delete"
+    last_delete = np.maximum.reduceat(
+        np.where(is_delete, pos, -1), starts)
+    alive = pos > last_delete[seg_of]
+
+    is_set = events_s == "$set"
+    live_seg = np.zeros(len(starts), dtype=bool)
+    live_seg[seg_of[alive & is_set]] = True
+
+    # flatten surviving rows into (segment, key, position, is_set, value)
+    surv = np.flatnonzero(alive & (is_set | (events_s == "$unset")))
+    f_seg, f_key, f_pos, f_set, f_val = [], [], [], [], []
+    props_s_idx = order[surv]          # original row ids of survivors
+    for p_i, s_i in zip(props_s_idx, surv):
+        raw = props[p_i]
+        fields = json.loads(raw) if raw else {}
+        seg = seg_of[s_i]
+        setop = bool(is_set[s_i])
+        for k, v in fields.items():
+            f_seg.append(seg)
+            f_key.append(k)
+            f_pos.append(s_i)
+            f_set.append(setop)
+            f_val.append(v)
+
+    out_fields = {int(s): {} for s in np.flatnonzero(live_seg)}
+    if f_seg:
+        f_seg = np.asarray(f_seg, dtype=np.int64)
+        f_pos = np.asarray(f_pos, dtype=np.int64)
+        f_set = np.asarray(f_set, dtype=bool)
+        _, key_codes = assign_indices(np.asarray(f_key, dtype=object))
+        # last-wins per (segment, key): sort and keep each group's tail
+        forder = np.lexsort((f_pos, key_codes, f_seg))
+        gs, gk = f_seg[forder], key_codes[forder]
+        is_last = np.r_[(gs[1:] != gs[:-1]) | (gk[1:] != gk[:-1]), True]
+        winners = forder[is_last]
+        for w in winners[f_set[winners]]:
+            seg = int(f_seg[w])
+            if seg in out_fields:
+                out_fields[seg][f_key[w]] = f_val[w]
+
+    def _dt(ms: int) -> dt.datetime:
+        return dt.datetime.fromtimestamp(ms / 1000, tz=UTC)
+
+    req = list(required) if required else None
+    out = {}
+    for seg, fields in out_fields.items():
+        if req and not all(r in fields for r in req):
+            continue
+        out[str(seg_entity[seg])] = PropertyMap(
+            fields, _dt(int(first_ms[seg])), _dt(int(last_ms[seg])))
+    return out
+
+
+def property_column(table: pa.Table, key: str, dtype=np.float32) -> np.ndarray:
+    """Extract one numeric property from the JSON properties column.
+
+    Dictionary-encodes the column first and parses each DISTINCT JSON
+    string once: property payloads on interaction events are drawn from a
+    tiny value set (ratings 1-5, weights), so a million-row scan costs a
+    handful of `json.loads` plus one vectorized gather."""
+    import pyarrow.compute as pc
+
+    n = table.num_rows
+    if n == 0:
+        return np.empty(0, dtype=dtype)
+    enc = table.column("properties").combine_chunks().dictionary_encode()
+    vocab = enc.dictionary.to_pylist()
+    parsed = np.asarray(
+        [np.nan if p is None else json.loads(p).get(key, np.nan)
+         for p in vocab], dtype=dtype)
+    codes = enc.indices
+    null_mask = np.asarray(pc.is_null(codes).to_numpy(zero_copy_only=False))
+    idx = np.asarray(pc.fill_null(codes, 0).to_numpy(zero_copy_only=False),
+                     dtype=np.int64)
+    out = parsed[idx]
+    out[null_mask] = np.nan
     return out
 
 

@@ -66,6 +66,15 @@ class EntityMap(Generic[T]):
     def data_by_int_id(self, int_id: int) -> T:
         return self._data[self.entity_id_of(int_id)]
 
+    # -- columnar construction ----------------------------------------------
+    @classmethod
+    def from_columnar(cls, entity_ids, payloads) -> "EntityMap[T]":
+        """Build from parallel (entity_id, payload) columns — the shape a
+        columnar scan hands over. Later rows win on duplicate ids
+        (dict-update semantics); the id space is the usual sorted
+        `BiMap.string_int` assignment over the distinct ids."""
+        return cls({str(e): p for e, p in zip(entity_ids, payloads)})
+
     # -- transforms ---------------------------------------------------------
     def map_values(self, fn: Callable[[T], U]) -> "EntityMap[U]":
         return EntityMap({k: fn(v) for k, v in self._data.items()},

@@ -11,6 +11,7 @@ Parity with the reference's engine-facing facades:
 from __future__ import annotations
 
 import datetime as _dt
+import threading
 from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 from predictionio_tpu.data.datamap import PropertyMap
@@ -19,17 +20,24 @@ from predictionio_tpu.storage.base import UNFILTERED, StorageError
 from predictionio_tpu.storage.registry import Storage
 
 _channel_cache: Dict[Tuple[str, Optional[str]], Tuple[int, Optional[int]]] = {}
+#: guards _channel_cache: concurrent first-touch resolves from the query
+#: server's batcher worker threads would otherwise race the dict fill
+_channel_cache_lock = threading.Lock()
 
 
 def resolve_app(app_name: str, channel_name: Optional[str] = None
                 ) -> Tuple[int, Optional[int]]:
     """app name (+ optional channel name) -> (app_id, channel_id).
 
-    Cached, like store/Common.scala:25-60.
+    Cached, like store/Common.scala:25-60. Thread-safe: the metadata
+    lookup runs outside the lock (it can hit storage), so two threads may
+    race to resolve the same fresh key — both compute the same value and
+    the second write is a no-op.
     """
     key = (app_name, channel_name)
-    if key in _channel_cache:
-        return _channel_cache[key]
+    with _channel_cache_lock:
+        if key in _channel_cache:
+            return _channel_cache[key]
     app = Storage.get_meta_data_apps().get_by_name(app_name)
     if app is None:
         raise StorageError(f"Invalid app name {app_name}")
@@ -41,12 +49,17 @@ def resolve_app(app_name: str, channel_name: Optional[str] = None
             raise StorageError(
                 f"Invalid channel name {channel_name} for app {app_name}")
         channel_id = matched[0].id
-    _channel_cache[key] = (app.id, channel_id)
+    with _channel_cache_lock:
+        _channel_cache[key] = (app.id, channel_id)
     return app.id, channel_id
 
 
 def clear_cache() -> None:
-    _channel_cache.clear()
+    with _channel_cache_lock:
+        _channel_cache.clear()
+    from predictionio_tpu.data.ingest import clear_scan_cache
+
+    clear_scan_cache()
 
 
 class EventStoreClient:
@@ -122,6 +135,16 @@ class EventStoreClient:
         """Training-path columnar read (PEventStore.find -> pyarrow.Table)."""
         app_id, channel_id = resolve_app(app_name, channel_name)
         return Storage.get_events().find_columnar(app_id, channel_id, **filters)
+
+    @staticmethod
+    def snapshot_digest(app_name: str, channel_name: Optional[str] = None):
+        """Cheap content fingerprint of the app's event namespace (None
+        when the backend cannot produce one) — the ingest scan-cache key
+        (data/ingest.py): equal digests promise an identical rescan."""
+        app_id, channel_id = resolve_app(app_name, channel_name)
+        store = Storage.get_events()
+        fn = getattr(store, "snapshot_digest", None)
+        return fn(app_id, channel_id) if fn is not None else None
 
     @staticmethod
     def read_snapshot(app_name: str, channel_name: Optional[str] = None):

@@ -25,8 +25,7 @@ from typing import Dict, Optional, Sequence
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from predictionio_tpu.data.aggregator import (
-    AGGREGATOR_EVENT_NAMES, aggregate_properties)
+from predictionio_tpu.data.aggregator import AGGREGATOR_EVENT_NAMES
 from predictionio_tpu.data.columnar import events_to_table, table_to_events
 from predictionio_tpu.data.datamap import PropertyMap
 from predictionio_tpu.data.event import millis
@@ -135,8 +134,12 @@ class BatchView(DataView):
 
     def aggregate_properties(self, entity_type: str) -> Dict[str, PropertyMap]:
         """$set/$unset/$delete fold over the snapshot (PBatchView
-        aggregateProperties parity), reusing the canonical aggregator so the
-        view path and the store path cannot diverge."""
+        aggregateProperties parity) via the vectorized columnar fold —
+        the view already holds the arrow table, so no per-Event
+        materialization (parity with the row fold is covered by the
+        randomized equivalence suite in tests/test_ingest.py)."""
+        from predictionio_tpu.data.columnar import aggregate_properties_table
+
         rows = self.filtered_table(event_names=AGGREGATOR_EVENT_NAMES,
                                    entity_type=entity_type)
-        return aggregate_properties(table_to_events(rows))
+        return aggregate_properties_table(rows)

@@ -26,7 +26,6 @@ from predictionio_tpu.core import (
     AverageMetric, Engine, EngineParams, FirstServing, Params, Preparator,
 )
 from predictionio_tpu.core.base import Algorithm, DataSource
-from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.forest import ForestModel, ForestParams, train_forest
 from predictionio_tpu.models.logreg import LogRegModel, LogRegParams, train_logreg
 from predictionio_tpu.models.naive_bayes import MultinomialNBModel, train_multinomial_nb
@@ -81,9 +80,13 @@ class ClassificationDataSource(DataSource):
         self.params = params
 
     def _points(self) -> List[LabeledVector]:
-        props = EventStoreClient.aggregate_properties(
-            self.params.app_name, "user",
-            required=["plan", *ATTRS])
+        """Training read: the columnar $set/$unset/$delete fold (cached +
+        instrumented through data/ingest); the per-entity loop below is
+        over aggregated entities, not events."""
+        from predictionio_tpu.data.ingest import aggregate_scan
+
+        props = aggregate_scan(self.params.app_name, "user",
+                               required=["plan", *ATTRS])
         return [
             LabeledVector(
                 label=float(pm.get("plan")),

@@ -38,3 +38,31 @@ def categories_match(item: Optional[Item], wanted) -> bool:
         return True
     cats = (item or Item()).categories or []
     return bool(set(wanted) & set(cats))
+
+
+@dataclasses.dataclass
+class InteractionColumns:
+    """Columnar entity->target interactions: parallel arrays straight
+    from the event store's columnar scan (the RDD[event] analog the way
+    a TPU pipeline wants it — no per-event Python objects). Engines that
+    never read times/likes leave them None."""
+
+    users: "object"                  # np.ndarray object (string ids)
+    items: "object"                  # np.ndarray object
+    times: Optional["object"] = None  # np.ndarray int64 epoch ms
+    likes: Optional["object"] = None  # np.ndarray bool (like=True)
+
+    def __len__(self) -> int:
+        return len(self.users)
+
+
+def item_meta_join(item_vocab, items: Dict[str, Item]) -> Dict[int, Item]:
+    """Join `$set` item metadata onto a trained sorted vocab: one
+    vectorized batch lookup instead of a per-item binary search."""
+    import numpy as np
+
+    from predictionio_tpu.data.bimap import batch_lookup
+
+    ids = np.asarray(list(items), dtype=object)
+    idxs = batch_lookup(item_vocab, ids)
+    return {int(ix): items[str(k)] for ix, k in zip(idxs, ids) if ix >= 0}

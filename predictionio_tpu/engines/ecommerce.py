@@ -27,7 +27,8 @@ from predictionio_tpu.core import Engine, EngineParams, FirstServing, Params, Pr
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
 from predictionio_tpu.engines.common import (
-    Item, ItemScore, PredictedResult, categories_match,
+    InteractionColumns, Item, ItemScore, PredictedResult, categories_match,
+    item_meta_join,
 )
 from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
@@ -37,8 +38,17 @@ from predictionio_tpu.models.als import ALSData, ALSParams, train_als
 class TrainingData:
     users: Dict[str, dict]
     items: Dict[str, Item]
-    view_events: List[Tuple[str, str]]   # (user, item)
-    buy_events: List[Tuple[str, str]]
+    views: InteractionColumns
+    buys: InteractionColumns
+
+    # row-pair views kept for reference-API parity / inspection
+    @property
+    def view_events(self) -> List[Tuple[str, str]]:
+        return list(zip(self.views.users, self.views.items))
+
+    @property
+    def buy_events(self) -> List[Tuple[str, str]]:
+        return list(zip(self.buys.users, self.buys.items))
 
 
 PreparedData = TrainingData
@@ -71,20 +81,26 @@ class ECommerceDataSource(DataSource):
         self.params = params
 
     def read_training(self, ctx) -> TrainingData:
+        from predictionio_tpu.data.ingest import (
+            aggregate_scan, event_columns, training_scan,
+        )
+
         app = self.params.app_name
         users = {uid: dict(pm.fields) for uid, pm in
-                 EventStoreClient.aggregate_properties(app, "user").items()}
+                 aggregate_scan(app, "user").items()}
         items = {iid: Item(categories=pm.get_opt("categories"))
-                 for iid, pm in
-                 EventStoreClient.aggregate_properties(app, "item").items()}
-        views, buys = [], []
-        for e in EventStoreClient.find(
-                app_name=app, entity_type="user",
-                event_names=["view", "buy"], target_entity_type="item"):
-            pair = (e.entity_id, e.target_entity_id)
-            (views if e.event == "view" else buys).append(pair)
-        return TrainingData(users=users, items=items,
-                            view_events=views, buy_events=buys)
+                 for iid, pm in aggregate_scan(app, "item").items()}
+        scan = training_scan(
+            app, entity_type="user", event_names=["view", "buy"],
+            target_entity_type="item",
+            columns=("event", "entity_id", "target_entity_id"))
+        events, u, i = event_columns(
+            scan.table, "event", "entity_id", "target_entity_id")
+        is_view = events == "view"
+        return TrainingData(
+            users=users, items=items,
+            views=InteractionColumns(u[is_view], i[is_view]),
+            buys=InteractionColumns(u[~is_view], i[~is_view]))
 
 
 class ECommercePreparator(Preparator):
@@ -138,21 +154,23 @@ class ECommAlgorithm(Algorithm):
     # -- train ---------------------------------------------------------------
     def train(self, ctx, pd: PreparedData) -> ECommModel:
         """ECommAlgorithm.train:84 — view (1x) + buy (stronger) implicit
-        ratings; popularity from buy counts (trainDefault:211)."""
+        ratings; popularity from buy counts (trainDefault:211). All folds
+        are vectorized pair aggregations over the columnar scan."""
+        from predictionio_tpu.data.bimap import batch_lookup
+        from predictionio_tpu.data.ingest import pair_counts
+
         if not pd.items:
             raise ValueError("items cannot be empty (use $set item events)")
-        counts: Dict[Tuple[str, str], float] = {}
-        for u, i in pd.view_events:
-            counts[(u, i)] = counts.get((u, i), 0.0) + 1.0
         # genMLlibRating in the rate-event variant weighs buys like a rating
         # of BUY_WEIGHT; here buys add extra implicit confidence
-        for u, i in pd.buy_events:
-            counts[(u, i)] = counts.get((u, i), 0.0) + 2.0
-        if not counts:
+        all_users = np.concatenate([pd.views.users, pd.buys.users])
+        all_items = np.concatenate([pd.views.items, pd.buys.items])
+        weights = np.concatenate([
+            np.ones(len(pd.views), np.float32),
+            np.full(len(pd.buys), 2.0, np.float32)])
+        users, items, values = pair_counts(all_users, all_items, weights)
+        if not len(values):
             raise ValueError("view/buy events cannot be empty")
-        users = np.asarray([k[0] for k in counts], dtype=object)
-        items = np.asarray([k[1] for k in counts], dtype=object)
-        values = np.asarray(list(counts.values()), dtype=np.float32)
         user_vocab, user_codes = assign_indices(users)
         item_vocab, item_codes = assign_indices(items)
         from predictionio_tpu.workflow.context import mesh_of
@@ -164,16 +182,11 @@ class ECommAlgorithm(Algorithm):
             rank=self.params.rank, num_iterations=self.params.num_iterations,
             reg=self.params.reg, alpha=self.params.alpha,
             implicit_prefs=True, seed=self.params.seed))
-        item_meta: Dict[int, Item] = {}
-        for iid, item in pd.items.items():
-            idx = vocab_index(item_vocab, iid)
-            if idx is not None:
-                item_meta[idx] = item
-        popular: Dict[int, int] = {}
-        for _, i in pd.buy_events:
-            idx = vocab_index(item_vocab, i)
-            if idx is not None:
-                popular[idx] = popular.get(idx, 0) + 1
+        item_meta = item_meta_join(item_vocab, pd.items)
+        buy_idx = batch_lookup(item_vocab, pd.buys.items)
+        buy_idx = buy_idx[buy_idx >= 0]
+        popular = {int(ix): int(c) for ix, c in
+                   zip(*np.unique(buy_idx, return_counts=True))}
         Vn = V / np.maximum(np.linalg.norm(V, axis=1, keepdims=True), 1e-9)
         return ECommModel(user_vocab=user_vocab, item_vocab=item_vocab,
                           U=U, V=V, V_normalized=Vn, items=item_meta,

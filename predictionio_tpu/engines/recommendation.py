@@ -159,48 +159,35 @@ class RecommendationDataSource(DataSource):
                 for u, i, v in zip(c.users, c.items, c.values)]
 
     def _read_columns(self) -> RatingColumns:
-        """Columnar training read (find_columnar -> arrays), the
-        JDBCPEvents-into-RDD analog without per-event objects.
+        """Columnar training read (the shared ingest pipeline -> arrays),
+        the JDBCPEvents-into-RDD analog without per-event objects.
 
         On a multi-process runtime this read is PARTITIONED exactly like
         the reference's per-executor JdbcRDD slices
-        (JDBCPEvents.scala:89-101): every process reads only its shard of
-        one collectively-agreed snapshot, and the downstream algorithm
-        re-keys rows to their owners over the interconnect
-        (models/als.build_distributed) — no process materializes the full
-        event set."""
+        (JDBCPEvents.scala:89-101): `training_scan(sharded=True)` makes
+        every process read only its shard of one collectively-agreed
+        snapshot, and the downstream algorithm re-keys rows to their
+        owners over the interconnect (models/als.build_distributed) — no
+        process materializes the full event set."""
         from predictionio_tpu.data.columnar import property_column
+        from predictionio_tpu.data.ingest import event_columns, training_scan
 
         names = self.params.event_names or ["rate", "buy"]
         weights = {**self.DEFAULT_WEIGHTS, **(self.params.event_weights or {})}
-        shard = None
         import jax
 
-        if jax.process_count() > 1:
-            from predictionio_tpu.parallel.shuffle import allgather_object
-
-            snap = allgather_object(
-                EventStoreClient.read_snapshot(self.params.app_name)
-                if jax.process_index() == 0 else None)[0]
-            if snap is not None:
-                shard = (jax.process_index(), jax.process_count(), snap)
-            # snap None = the backend cannot partition (no
-            # read_snapshot): every process reads the full set (the
-            # pre-partitioned cost) but must then keep a DISJOINT local
-            # slice — the distributed build downstream exchanges rows by
-            # owner and would double-count replicated reads
-        table = EventStoreClient.find_columnar(
-            app_name=self.params.app_name,
+        scan = training_scan(
+            self.params.app_name,
+            sharded=True,
             entity_type="user",
             event_names=names,
             target_entity_type="item",
             ordered=False,     # rating math is permutation-invariant
-            shard=shard)
-        events = np.asarray(table.column("event").to_pylist(), dtype=object)
-        users = np.asarray(table.column("entity_id").to_pylist(),
-                           dtype=object)
-        items = np.asarray(table.column("target_entity_id").to_pylist(),
-                           dtype=object)
+            columns=("event", "entity_id", "target_entity_id",
+                     "properties"))
+        table = scan.table
+        events, users, items = event_columns(
+            table, "event", "entity_id", "target_entity_id")
         is_rate = events == "rate"
         values = np.empty(len(events), np.float32)
         for name in set(events.tolist()):
@@ -226,13 +213,10 @@ class RecommendationDataSource(DataSource):
             raise ValueError(
                 "rate event without a rating property "
                 "(DataSource.scala:66 MatchError parity)")
-        if jax.process_count() > 1 and shard is None:
-            # replicated read (backend couldn't partition): keep a
-            # disjoint strided slice so the distributed build's
-            # exchange-by-owner sees each rating exactly once
-            p, np_ = jax.process_index(), jax.process_count()
-            return RatingColumns(users=users[p::np_], items=items[p::np_],
-                                 values=values[p::np_])
+        # replicated fallback (backend couldn't partition): keep a
+        # disjoint strided slice so the distributed build's
+        # exchange-by-owner sees each rating exactly once
+        users, items, values = scan.local_slice((users, items, values))
         return RatingColumns(users=users, items=items, values=values)
 
     def read_training(self, ctx) -> TrainingData:

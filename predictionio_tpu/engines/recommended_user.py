@@ -31,8 +31,6 @@ import numpy as np
 from predictionio_tpu.core import Engine, EngineParams, FirstServing, Params, Preparator
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
-from predictionio_tpu.data.event import millis
-from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
 
 
@@ -46,9 +44,28 @@ class FollowEvent:
 
 
 @dataclasses.dataclass
+class FollowColumns:
+    """Columnar user->user follow edges from the event scan."""
+
+    users: np.ndarray           # object (follower ids)
+    followed: np.ndarray        # object (followed ids)
+    times: np.ndarray           # int64 epoch ms
+
+    def __len__(self) -> int:
+        return len(self.users)
+
+
+@dataclasses.dataclass
 class TrainingData:
     users: Dict[str, dict]
-    follow_events: List[FollowEvent]
+    follows: FollowColumns
+
+    # row-object view kept for reference-API parity / inspection
+    @property
+    def follow_events(self) -> List[FollowEvent]:
+        return [FollowEvent(u, f, int(t)) for u, f, t in
+                zip(self.follows.users, self.follows.followed,
+                    self.follows.times)]
 
 
 PreparedData = TrainingData
@@ -101,16 +118,21 @@ class RecommendedUserDataSource(DataSource):
         self.params = params
 
     def read_training(self, ctx) -> TrainingData:
+        from predictionio_tpu.data.ingest import (
+            aggregate_scan, event_columns, training_scan,
+        )
+
         app = self.params.app_name
         users = {uid: dict(pm.fields) for uid, pm in
-                 EventStoreClient.aggregate_properties(app, "user").items()}
-        follows = [
-            FollowEvent(e.entity_id, e.target_entity_id,
-                        millis(e.event_time))
-            for e in EventStoreClient.find(
-                app_name=app, entity_type="user",
-                event_names=["follow"], target_entity_type="user")]
-        return TrainingData(users=users, follow_events=follows)
+                 aggregate_scan(app, "user").items()}
+        scan = training_scan(
+            app, entity_type="user", event_names=["follow"],
+            target_entity_type="user",
+            columns=("entity_id", "target_entity_id", "event_time_ms"))
+        u, f, t = event_columns(
+            scan.table, "entity_id", "target_entity_id", "event_time_ms")
+        return TrainingData(users=users,
+                            follows=FollowColumns(u, f, t))
 
 
 class RecommendedUserPreparator(Preparator):
@@ -151,28 +173,27 @@ class ALSAlgorithm(Algorithm):
         self.params = params or ALSAlgorithmParams()
 
     def train(self, ctx, pd: PreparedData) -> RecommendedUserModel:
-        if not pd.follow_events:
+        from predictionio_tpu.data.bimap import batch_lookup
+        from predictionio_tpu.data.ingest import pair_counts
+
+        if not len(pd.follows):
             raise ValueError("follow events cannot be empty "
                              "(ALSAlgorithm.scala require parity)")
         if not pd.users:
             raise ValueError("users cannot be empty (use $set user events)")
-        known = set(pd.users)
+        # reference drops events whose ids miss the BiMap built from the
+        # $set user set (uindex == -1 filter) — one vectorized membership
+        # test against the sorted known-user vocab
+        known = np.unique(np.asarray(list(pd.users), dtype=object))
+        valid = ((batch_lookup(known, pd.follows.users) >= 0)
+                 & (batch_lookup(known, pd.follows.followed) >= 0))
         # each follow contributes confidence 1; repeats sum — MLlib
         # trainImplicit aggregates duplicate MLlibRating triples the same way
-        counts: Dict[Tuple[str, str], float] = {}
-        for f in pd.follow_events:
-            # reference drops events whose ids miss the BiMap built from
-            # the $set user set (uindex == -1 filter)
-            if f.user not in known or f.followed_user not in known:
-                continue
-            key = (f.user, f.followed_user)
-            counts[key] = counts.get(key, 0.0) + 1.0
-        if not counts:
+        followers, followed, values = pair_counts(
+            pd.follows.users[valid], pd.follows.followed[valid])
+        if not len(values):
             raise ValueError("no follow events with valid user ids "
                              "(mllibRatings require parity)")
-        followers = np.asarray([k[0] for k in counts], dtype=object)
-        followed = np.asarray([k[1] for k in counts], dtype=object)
-        values = np.asarray(list(counts.values()), dtype=np.float32)
         f_vocab, f_codes = assign_indices(followers)
         t_vocab, t_codes = assign_indices(followed)
         from predictionio_tpu.workflow.context import mesh_of

@@ -14,14 +14,13 @@ items back.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 from predictionio_tpu.core.base import (
     Algorithm, DataSource, FirstServing, Preparator,
 )
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.params import EngineParams, Params
-from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.seqrec import (
     SeqRecModel, SeqRecParams, train_seqrec,
 )
@@ -94,20 +93,19 @@ class SessionDataSource(DataSource):
         self.params = params
 
     def _read_sessions(self) -> List[List[str]]:
-        events = EventStoreClient.find(
-            app_name=self.params.app_name,
+        from predictionio_tpu.data.ingest import (
+            event_columns, sessions_by_entity, training_scan,
+        )
+
+        scan = training_scan(
+            self.params.app_name,
             entity_type="user",
             event_names=list(self.params.event_names),
-            target_entity_type="item")
-        by_user: Dict[str, list] = {}
-        for e in events:
-            by_user.setdefault(e.entity_id, []).append(
-                (e.event_time, e.target_entity_id))
-        sessions = []
-        for user, pairs in sorted(by_user.items()):
-            pairs.sort(key=lambda p: p[0])
-            sessions.append([item for _, item in pairs])
-        return sessions
+            target_entity_type="item",
+            columns=("entity_id", "target_entity_id", "event_time_ms"))
+        users, items, times = event_columns(
+            scan.table, "entity_id", "target_entity_id", "event_time_ms")
+        return sessions_by_entity(users, items, times)
 
     def read_training(self, ctx) -> TrainingData:
         return TrainingData(sessions=self._read_sessions())

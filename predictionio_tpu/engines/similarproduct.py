@@ -27,10 +27,9 @@ from predictionio_tpu.core import Engine, EngineParams, FirstServing, Params, Pr
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
 from predictionio_tpu.engines.common import (
-    Item, ItemScore, PredictedResult, categories_match,
+    InteractionColumns, Item, ItemScore, PredictedResult, categories_match,
+    item_meta_join,
 )
-from predictionio_tpu.data.event import millis
-from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
 from predictionio_tpu.models.cooccurrence import CooccurrenceModel, train_cooccurrence
 
@@ -56,8 +55,21 @@ class LikeEvent:
 class TrainingData:
     users: Dict[str, dict]
     items: Dict[str, Item]
-    view_events: List[ViewEvent]
-    like_events: List[LikeEvent]
+    views: InteractionColumns
+    likes: InteractionColumns
+
+    # row-object views kept for reference-API parity / inspection; the
+    # algorithms consume the columns directly
+    @property
+    def view_events(self) -> List[ViewEvent]:
+        return [ViewEvent(u, i, int(t)) for u, i, t in
+                zip(self.views.users, self.views.items, self.views.times)]
+
+    @property
+    def like_events(self) -> List[LikeEvent]:
+        return [LikeEvent(u, i, int(t), bool(l)) for u, i, t, l in
+                zip(self.likes.users, self.likes.items, self.likes.times,
+                    self.likes.likes)]
 
 
 PreparedData = TrainingData
@@ -96,25 +108,33 @@ class SimilarProductDataSource(DataSource):
         self.params = params
 
     def read_training(self, ctx) -> TrainingData:
+        from predictionio_tpu.data.ingest import (
+            aggregate_scan, event_columns, training_scan,
+        )
+
         app = self.params.app_name
+        # entity properties via the columnar $set/$unset/$delete fold
         users = {uid: dict(pm.fields) for uid, pm in
-                 EventStoreClient.aggregate_properties(app, "user").items()}
+                 aggregate_scan(app, "user").items()}
         items = {iid: Item(categories=pm.get_opt("categories"))
-                 for iid, pm in
-                 EventStoreClient.aggregate_properties(app, "item").items()}
-        views, likes = [], []
-        for e in EventStoreClient.find(
-                app_name=app, entity_type="user",
-                event_names=["view", "like", "dislike"],
-                target_entity_type="item"):
-            t = millis(e.event_time)
-            if e.event == "view":
-                views.append(ViewEvent(e.entity_id, e.target_entity_id, t))
-            else:
-                likes.append(LikeEvent(e.entity_id, e.target_entity_id, t,
-                                       like=(e.event == "like")))
-        return TrainingData(users=users, items=items, view_events=views,
-                            like_events=likes)
+                 for iid, pm in aggregate_scan(app, "item").items()}
+        # ONE columnar scan for all three interaction kinds, split by mask
+        scan = training_scan(
+            app, entity_type="user",
+            event_names=["view", "like", "dislike"],
+            target_entity_type="item",
+            columns=("event", "entity_id", "target_entity_id",
+                     "event_time_ms"))
+        events, u, i, t = event_columns(
+            scan.table, "event", "entity_id", "target_entity_id",
+            "event_time_ms")
+        is_view = events == "view"
+        return TrainingData(
+            users=users, items=items,
+            views=InteractionColumns(u[is_view], i[is_view], t[is_view]),
+            likes=InteractionColumns(
+                u[~is_view], i[~is_view], t[~is_view],
+                likes=(events[~is_view] == "like")))
 
 
 class SimilarProductPreparator(Preparator):
@@ -191,22 +211,20 @@ class ALSAlgorithm(Algorithm):
     def __init__(self, params: Optional[ALSAlgorithmParams] = None):
         self.params = params or ALSAlgorithmParams()
 
-    def _ratings(self, pd: PreparedData) -> List[Tuple[str, str, float]]:
-        counts: Dict[Tuple[str, str], float] = {}
-        for v in pd.view_events:
-            counts[(v.user, v.item)] = counts.get((v.user, v.item), 0) + 1
-        return [(u, i, c) for (u, i), c in counts.items()]
+    def _ratings(self, pd: PreparedData):
+        """Deduplicated view counts as (users, items, values) columns —
+        the vectorized `counts[(u, i)] += 1` fold."""
+        from predictionio_tpu.data.ingest import pair_counts
+
+        return pair_counts(pd.views.users, pd.views.items)
 
     def train(self, ctx, pd: PreparedData) -> SimilarityModel:
-        ratings = self._ratings(pd)
-        if not ratings:
+        users, items, values = self._ratings(pd)
+        if not len(values):
             raise ValueError("view/like events cannot be empty "
                              "(ALSAlgorithm.scala:66 require parity)")
         if not pd.items:
             raise ValueError("items cannot be empty (use $set item events)")
-        users = np.asarray([r[0] for r in ratings], dtype=object)
-        items = np.asarray([r[1] for r in ratings], dtype=object)
-        values = np.asarray([r[2] for r in ratings], dtype=np.float32)
         user_vocab, user_codes = assign_indices(users)
         item_vocab, item_codes = assign_indices(items)
         from predictionio_tpu.workflow.context import mesh_of
@@ -220,12 +238,8 @@ class ALSAlgorithm(Algorithm):
             implicit_prefs=True, seed=self.params.seed))
         norms = np.linalg.norm(V, axis=1, keepdims=True)
         V = V / np.where(norms == 0, 1.0, norms)
-        item_meta = {}
-        for iid, item in pd.items.items():
-            idx = vocab_index(item_vocab, iid)
-            if idx is not None:
-                item_meta[idx] = item
-        return SimilarityModel(item_vocab=item_vocab, V=V, items=item_meta)
+        return SimilarityModel(item_vocab=item_vocab, V=V,
+                               items=item_meta_join(item_vocab, pd.items))
 
     def predict(self, model: SimilarityModel, query: Query) -> PredictedResult:
         query_idx = {i for i in (model.item_index(x) for x in query.items)
@@ -266,13 +280,11 @@ class LikeAlgorithm(ALSAlgorithm):
     like=+1, dislike=-1, into implicit ALS."""
 
     def _ratings(self, pd: PreparedData):
-        latest: Dict[Tuple[str, str], LikeEvent] = {}
-        for e in pd.like_events:
-            key = (e.user, e.item)
-            if key not in latest or e.t > latest[key].t:
-                latest[key] = e
-        return [(u, i, 1.0 if e.like else -1.0)
-                for (u, i), e in latest.items()]
+        from predictionio_tpu.data.ingest import latest_per_pair
+
+        values = np.where(pd.likes.likes, 1.0, -1.0).astype(np.float32)
+        return latest_per_pair(pd.likes.users, pd.likes.items,
+                               pd.likes.times, values)
 
 
 @dataclasses.dataclass
@@ -293,12 +305,12 @@ class CooccurrenceAlgorithm(Algorithm):
         self.params = params or CooccurrenceAlgorithmParams()
 
     def train(self, ctx, pd: PreparedData) -> CooccurrenceEngineModel:
-        if not pd.view_events:
+        if not len(pd.views):
             raise ValueError("view events cannot be empty")
-        users = np.asarray([v.user for v in pd.view_events], dtype=object)
-        items = np.asarray([v.item for v in pd.view_events], dtype=object)
-        user_vocab, user_codes = assign_indices(users)
-        item_vocab, item_codes = assign_indices(items)
+        from predictionio_tpu.data.ingest import intern_pairs
+
+        user_vocab, user_codes, item_vocab, item_codes = intern_pairs(
+            pd.views.users, pd.views.items)
         from predictionio_tpu.workflow.context import mesh_of
 
         top = train_cooccurrence(user_codes, item_codes,
@@ -306,12 +318,8 @@ class CooccurrenceAlgorithm(Algorithm):
                                  self.params.n, mesh=mesh_of(ctx))
         model = CooccurrenceModel(item_vocab=item_vocab,
                                   top_cooccurrences=top)
-        item_meta = {}
-        for iid, item in pd.items.items():
-            idx = model.item_index(iid)
-            if idx is not None:
-                item_meta[idx] = item
-        return CooccurrenceEngineModel(model=model, items=item_meta)
+        return CooccurrenceEngineModel(
+            model=model, items=item_meta_join(item_vocab, pd.items))
 
     def predict(self, m: CooccurrenceEngineModel, query: Query
                 ) -> PredictedResult:

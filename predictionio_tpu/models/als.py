@@ -328,7 +328,7 @@ def _half_sweep(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
 
 def _make_sweeps(mesh: Mesh, data_dims, params: ALSParams):
     """Build the shard_map'd user/item half-sweeps for the given mesh."""
-    from jax import shard_map
+    from predictionio_tpu.parallel.compat import shard_map
 
     n_users_pad, n_items_pad, ups, ips = data_dims
     axis = "data"

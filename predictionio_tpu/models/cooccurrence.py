@@ -135,7 +135,7 @@ def _sharded_topn_fn(mesh, axis: str, n_dev: int, blk: int, ni_pad: int,
     def build():
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from predictionio_tpu.parallel.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from jax.sharding import NamedSharding

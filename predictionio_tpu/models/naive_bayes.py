@@ -114,7 +114,7 @@ def _sharded_count_fn(mesh, axis: str, n_labels: int):
     def build():
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from predictionio_tpu.parallel.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         def count_block(c, x):

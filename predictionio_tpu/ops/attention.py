@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.parallel.compat import HAS_VMA, pcast_varying, shard_map
+
 NEG_INF = -1e30    # large-negative instead of -inf: avoids NaN in exp(m - m)
 
 
@@ -170,7 +172,7 @@ def _ring_attention_local(q, k, v, key_mask, *, axis: str, causal: bool,
     vary_axes = (axis,) if batch_axis is None else (axis, batch_axis)
 
     def _vary(x):
-        return jax.lax.pcast(x, vary_axes, to="varying")
+        return pcast_varying(x, vary_axes)
 
     o0 = _vary(jnp.zeros((b, h, lq, d), jnp.float32))
     m0 = _vary(jnp.full((b, h, lq), NEG_INF, jnp.float32))
@@ -244,10 +246,13 @@ def _sharded_fn(local_fn, mesh: Mesh, axis: str, causal: bool,
     composed with the sequence collective, which only spans `axis`)."""
     spec = P(batch_axis, axis, None, None)
     mask_spec = P(batch_axis, axis)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         functools.partial(local_fn, axis=axis, causal=causal,
                           batch_axis=batch_axis),
-        mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec))
+        mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec,
+        # the vma marking (pcast_varying on the scan carries) satisfies
+        # the new checker; the old replication checker has no equivalent
+        check_vma=HAS_VMA))
 
 
 def _ulysses_local(q, k, v, key_mask, *, axis: str, causal: bool,

@@ -1,0 +1,56 @@
+"""JAX version compatibility shims for the parallel/kernel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace (and grew the ``check_vma`` spelling of the
+old ``check_rep`` flag) across the JAX versions this repo must run on.
+Every kernel module imports it from here so the whole package tracks one
+resolution order:
+
+  1. ``jax.shard_map``                    (new API, ``check_vma``)
+  2. ``jax.experimental.shard_map``       (older releases, ``check_rep``)
+
+The wrapper translates the ``check_vma`` kwarg to ``check_rep`` when
+falling back, so call sites can uniformly use the new spelling.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+#: True when shard_map types device-varying values (the ``check_vma``
+#: API); False on the experimental fallback, whose replication checker
+#: cannot be satisfied by ``pcast_varying`` (an identity there) — bodies
+#: that rely on the marking must disable the check instead.
+HAS_VMA = "check_vma" in _PARAMS
+
+
+def shard_map(f=None, /, **kwargs):
+    """`jax.shard_map` with kwarg translation for older releases."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda g: _shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)
+
+
+def pcast_varying(x, axis_names):
+    """``jax.lax.pcast(x, names, to="varying")`` on releases that type
+    device-varying values inside shard_map; identity on older releases,
+    whose shard_map has no varying-axes type system to satisfy."""
+    import jax
+
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axis_names, to="varying")
+
+
+__all__ = ["shard_map", "pcast_varying", "HAS_VMA"]
+

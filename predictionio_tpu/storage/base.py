@@ -26,7 +26,6 @@ import secrets
 import uuid
 from typing import Dict, Iterator, List, Optional, Sequence
 
-from predictionio_tpu.data.aggregator import aggregate_properties as _aggregate
 from predictionio_tpu.data.datamap import PropertyMap
 from predictionio_tpu.data.event import Event, UTC
 
@@ -349,21 +348,27 @@ class EventStore(abc.ABC):
         until_time: Optional[_dt.datetime] = None,
         required: Optional[Sequence[str]] = None,
     ) -> Dict[str, PropertyMap]:
-        """LEvents.futureAggregateProperties:215 — fold special events."""
-        events = self.find(
+        """LEvents.futureAggregateProperties:215 — fold special events.
+
+        Backed by the backend's columnar scan + the vectorized sort/
+        segment fold (data/columnar.aggregate_properties_table), so every
+        backend's training read skips per-Event materialization; the
+        row-at-a-time fold (data/aggregator.py) remains the serving-path
+        and contract-spec reference implementation.
+        """
+        from predictionio_tpu.data.columnar import aggregate_properties_table
+
+        table = self.find_columnar(
             app_id=app_id,
             channel_id=channel_id,
+            ordered=False,      # the fold sorts per entity itself
             start_time=start_time,
             until_time=until_time,
             entity_type=entity_type,
             event_names=list(_SPECIAL),
+            columns=("event", "entity_id", "properties", "event_time_ms"),
         )
-        out = _aggregate(events)
-        if required:
-            req = list(required)
-            out = {k: v for k, v in out.items()
-                   if all(r in v for r in req)}
-        return out
+        return aggregate_properties_table(table, required=required)
 
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
                       ordered: bool = True, **filters):
@@ -388,8 +393,23 @@ class EventStore(abc.ABC):
                 f"{type(self).__name__} does not support sharded "
                 "(partitioned) reads")
         filters.pop("shard", None)
-        from predictionio_tpu.data.columnar import events_to_table
-        return events_to_table(self.find(app_id, channel_id, **filters))
+        columns = filters.pop("columns", None)
+        from predictionio_tpu.data.columnar import (
+            events_to_table, projected_schema,
+        )
+        table = events_to_table(self.find(app_id, channel_id, **filters))
+        return (table if columns is None
+                else table.select(projected_schema(columns).names))
+
+    def snapshot_digest(self, app_id: int,
+                        channel_id: Optional[int] = None) -> Optional[str]:
+        """Cheap fingerprint of the namespace's current contents, or None
+        when the backend cannot produce one. Equal digests mean a
+        repeated training scan would return the same rows — the cache key
+        for the ingest-side scan cache (data/ingest.py). Backends include
+        enough state (row window + count, fragment + tombstone lists)
+        that both appends and deletes change the digest."""
+        return None
 
 
 def shard_window(lo_all: int, hi_all: int, shard) -> "tuple[int, int]":

@@ -177,6 +177,17 @@ class ParquetEvents(base.EventStore):
         keep appending new ones."""
         return self._fragments(self._check_ns(app_id, channel_id))
 
+    def snapshot_digest(self, app_id: int,
+                        channel_id: Optional[int] = None) -> str:
+        """Fragment list + tombstone list: appends add fragments, deletes
+        add tombstones — either changes the digest (ingest-cache key)."""
+        import hashlib
+
+        ns = self._check_ns(app_id, channel_id)
+        state = ";".join(self._fragments(ns)) + "|" + ";".join(
+            sorted(self.client.fs.glob(f"{ns}/tomb-*")))
+        return "frags:" + hashlib.sha1(state.encode()).hexdigest()
+
     def _read_all(self, ns: str, shard=None) -> pa.Table:
         if shard is not None:
             idx, count = shard[0], shard[1]
@@ -249,8 +260,10 @@ class ParquetEvents(base.EventStore):
         limit: Optional[int] = None,
         reversed_order: bool = False,
         shard: Optional[tuple] = None,
+        columns=None,
     ) -> pa.Table:
         """Vectorized filter over all fragments — the training hot path.
+        ``columns`` projects the output to an EVENT_SCHEMA subset.
 
         ``shard=(index, count[, snapshot])`` assigns whole FRAGMENTS
         round-robin to one of `count` readers (the partitioned training
@@ -270,7 +283,7 @@ class ParquetEvents(base.EventStore):
                             "descending" if reversed_order else "ascending")])
         if limit is not None and limit >= 0:
             t = t.slice(0, limit)
-        return _to_columnar(t)
+        return _to_columnar(t, columns)
 
     def find(
         self,
@@ -330,20 +343,13 @@ class ParquetEvents(base.EventStore):
         return t.filter(mask)
 
 
-def _to_columnar(t: pa.Table) -> pa.Table:
+def _to_columnar(t: pa.Table, columns=None) -> pa.Table:
     """Store schema -> the shared columnar EVENT_SCHEMA layout
-    (data/columnar.py) consumed by DataSources."""
-    return pa.table({
-        "event_id": t.column("id"),
-        "event": t.column("event"),
-        "entity_type": t.column("entityType"),
-        "entity_id": t.column("entityId"),
-        "target_entity_type": t.column("targetEntityType"),
-        "target_entity_id": t.column("targetEntityId"),
-        "properties": t.column("properties"),
-        "event_time_ms": t.column("eventTime"),
-        "creation_time_ms": t.column("creationTime"),
-    })
+    (data/columnar.py) consumed by DataSources, optionally projected."""
+    from predictionio_tpu.data.columnar import SQL_COLUMN_OF, projected_schema
+
+    names = projected_schema(columns).names
+    return pa.table({n: t.column(SQL_COLUMN_OF[n]) for n in names})
 
 
 def _row_to_event(row: dict) -> Event:

@@ -278,14 +278,35 @@ class PostgresEvents(base.EventStore):
             f"SELECT MIN(eventTime), MAX(eventTime) FROM {name}").fetchone()
         return (row[0] or 0), (row[1] or 0) + 1
 
+    def snapshot_digest(self, app_id: int,
+                        channel_id: Optional[int] = None) -> str:
+        """(eventTime window, count, max creationTime) — the ingest-cache
+        key. The creationTime component covers an in-window delete +
+        insert pair (public ``delete`` exists, so the log is NOT
+        append-only): the replacement row's later creationTime changes
+        the digest even when MIN/MAX eventTime and COUNT all survive.
+        Remaining blind spot: a delete+insert whose replacement carries a
+        historical creationTime ≤ the current max — only bulk imports of
+        pre-stamped events can produce that."""
+        name = event_table_name(app_id, channel_id)
+        row = self.client.execute(
+            f"SELECT MIN(eventTime), MAX(eventTime), COUNT(*), "
+            f"MAX(creationTime) FROM {name}"
+        ).fetchone()
+        return f"time:{row[0]}:{row[1]}:{row[2]}:{row[3]}"
+
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
                       *, ordered: bool = True, limit: Optional[int] = None,
-                      reversed_order: bool = False, shard=None, **filters):
+                      reversed_order: bool = False, shard=None,
+                      columns=None, **filters):
         """Columnar scan -> pyarrow.Table (the JDBCPEvents.scala:35
         training read): SQL straight into columnar buffers, optional
         ``shard=(index, count[, snapshot])`` restricting to one eventTime
-        range partition (JDBCPEvents.scala:89-101)."""
-        from predictionio_tpu.data.columnar import rows_to_event_table
+        range partition (JDBCPEvents.scala:89-101); ``columns`` projects
+        the SELECT to the EVENT_SCHEMA subset the training read uses."""
+        from predictionio_tpu.data.columnar import (
+            SQL_COLUMN_OF, projected_schema, rows_to_event_table,
+        )
         from predictionio_tpu.storage.base import shard_window
 
         name = event_table_name(app_id, channel_id)
@@ -300,15 +321,16 @@ class PostgresEvents(base.EventStore):
             params.extend([lo, hi])
         if reversed_order or limit is not None:
             ordered = True
-        sql = (f"SELECT id, event, entityType, entityId, targetEntityType, "
-               f"targetEntityId, properties, eventTime, creationTime "
-               f"FROM {name} WHERE {' AND '.join(where)}")
+        out_names = projected_schema(columns).names
+        sel = ", ".join(SQL_COLUMN_OF[n] for n in out_names)
+        sql = f"SELECT {sel} FROM {name} WHERE {' AND '.join(where)}"
         if ordered:
             sql += f" ORDER BY eventTime {'DESC' if reversed_order else 'ASC'}"
         if limit is not None and limit >= 0:
             sql += " LIMIT %s"
             params.append(limit)
-        return rows_to_event_table(self.client.execute(sql, params).fetchall())
+        return rows_to_event_table(
+            self.client.execute(sql, params).fetchall(), out_names)
 
 
 def _row_to_event(row) -> Event:

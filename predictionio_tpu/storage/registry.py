@@ -87,6 +87,17 @@ class Storage:
         with cls._lock:
             cls._close_clients()
             cls._config = config
+        cls._drop_scan_cache()
+
+    @staticmethod
+    def _drop_scan_cache() -> None:
+        """Cached training scans belong to the PREVIOUS store: a fresh
+        backend can legitimately reproduce an old snapshot digest (same
+        rowid window, different rows), so reconfigure/reset must drop
+        them rather than trust the digest across stores."""
+        from predictionio_tpu.data.ingest import clear_scan_cache
+
+        clear_scan_cache()
 
     @classmethod
     def configure_memory(cls) -> None:
@@ -102,6 +113,7 @@ class Storage:
         with cls._lock:
             cls._close_clients()
             cls._config = None
+        cls._drop_scan_cache()
 
     @classmethod
     def _close_clients(cls) -> None:

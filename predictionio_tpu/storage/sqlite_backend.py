@@ -47,7 +47,9 @@ class SqliteClient:
     def __init__(self, path: str = ":memory:"):
         self.path = path
         self._local = threading.local()
-        self._lock = threading.Lock()
+        # reentrant: for :memory: the write lock and the shared-connection
+        # guard are the SAME lock, and holders of write_lock() call conn()
+        self._lock = threading.RLock()
         self._memory_conn: Optional[sqlite3.Connection] = None
         if path != ":memory:":
             Path(path).parent.mkdir(parents=True, exist_ok=True)
@@ -286,6 +288,27 @@ class SqliteEvents(base.EventStore):
             ) from ex
         return (row[0] or 0), (row[1] or 0) + 1
 
+    def snapshot_digest(self, app_id: int,
+                        channel_id: Optional[int] = None) -> str:
+        """(min rowid, max rowid, count, max creationTime): appends grow
+        the window, deletes shrink the count, and the creationTime
+        component covers delete-then-insert pairs — a plain rowid table
+        reuses MAX(rowid)+1 after the newest row is deleted, so window +
+        count alone could alias two different states; the replacement
+        row's later creationTime still changes the digest (ingest-cache
+        key)."""
+        name = event_table_name(app_id, channel_id)
+        try:
+            row = self.client.conn().execute(
+                f"SELECT MIN(rowid), MAX(rowid), COUNT(*), "
+                f"MAX(creationTime) FROM {name}"
+            ).fetchone()
+        except sqlite3.OperationalError as ex:
+            raise StorageError(
+                f"cannot read app {app_id} channel {channel_id}: {ex}"
+            ) from ex
+        return f"rowid:{row[0]}:{row[1]}:{row[2]}:{row[3]}"
+
     def find(self, app_id: int, channel_id: Optional[int] = None,
              **filters) -> Iterator[Event]:
         sql, params = self._find_sql(_EVENT_COLS, app_id, channel_id,
@@ -299,19 +322,24 @@ class SqliteEvents(base.EventStore):
             yield _row_to_event(row)
 
     def find_columnar(self, app_id: int, channel_id: Optional[int] = None,
-                      ordered: bool = True, **filters):
+                      ordered: bool = True, columns=None, **filters):
         """Direct columnar scan -> pyarrow.Table, skipping per-row Event/
         DataMap materialization (the JDBCPEvents.scala:35 training-read
         analog: SQL straight into the columnar buffers that feed device
         arrays). ``ordered=False`` (training reads) additionally drops
-        the global time sort. ``reversed_order``/``limit`` semantics
-        require the sort, so they force it back on."""
-        from predictionio_tpu.data.columnar import rows_to_event_table
+        the global time sort; ``columns`` projects the SELECT to the
+        EVENT_SCHEMA subset a training read actually consumes (fetching
+        9 columns to use 4 dominates the scan otherwise).
+        ``reversed_order``/``limit`` semantics require the sort, so they
+        force it back on."""
+        from predictionio_tpu.data.columnar import (
+            SQL_COLUMN_OF, projected_schema, rows_to_event_table,
+        )
 
         if filters.get("reversed_order") or filters.get("limit") is not None:
             ordered = True
-        cols = ("id, event, entityType, entityId, targetEntityType, "
-                "targetEntityId, properties, eventTime, creationTime")
+        names = projected_schema(columns).names
+        cols = ", ".join(SQL_COLUMN_OF[n] for n in names)
         sql, params = self._find_sql(cols, app_id, channel_id,
                                      ordered=ordered, **filters)
         try:
@@ -319,7 +347,7 @@ class SqliteEvents(base.EventStore):
         except sqlite3.OperationalError as ex:
             raise StorageError(
                 f"cannot read app {app_id} channel {channel_id}: {ex}") from ex
-        return rows_to_event_table(rows)
+        return rows_to_event_table(rows, names)
 
 
 def _row_to_event(row) -> Event:

@@ -67,6 +67,34 @@ def test_bench_serving_batching_smoke(tmp_path):
     assert detail["mean_batch_8c"] > 1.0
 
 
+def test_bench_train_ingest_smoke(tmp_path):
+    """Smoke the train_ingest config at a shrunken scale: the config
+    itself asserts per-event/columnar parity (identical interned code
+    streams), and the emitted detail must carry the rows/s + speedup +
+    cache-replay fields the judged run records for every swept backend."""
+    p = _run("train_ingest", "300", timeout=280, tmp_path=tmp_path,
+             extra_env={"BENCH_INGEST_EVENTS": "4000",
+                        "BENCH_INGEST_BACKENDS": "parquet,sqlite"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert "train_ingest" in out["unit"]
+    detail = next(d for d in
+                  json.load(open(tmp_path / "details.json"))["details"]
+                  if d["name"] == "train_ingest")
+    for backend in ("parquet", "sqlite"):
+        for key in (f"rows_per_s_per_event_{backend}_4000",
+                    f"rows_per_s_columnar_{backend}_4000",
+                    f"speedup_{backend}_4000",
+                    f"cache_hit_s_{backend}_4000"):
+            assert key in detail, (key, detail)
+        assert detail[f"rows_per_s_columnar_{backend}_4000"] > 0
+    # the columnar path must actually beat the per-event fold, even at
+    # smoke scale (the judged 100k sweep asserts nothing weaker)
+    assert detail["speedup_headline"] > 1.0, detail
+
+
 def test_bench_survives_wedged_worker_and_reports_partial(tmp_path):
     """A config that hangs its worker (the hidden _sleep_forever wedge
     simulator, budget 15s) must not take down the suite: the next config

@@ -188,6 +188,7 @@ def test_collectives_ring(mesh8):
     from jax.sharding import PartitionSpec as P
 
     from predictionio_tpu.parallel.collectives import psum, ring_pass, ring_reduce
+    from predictionio_tpu.parallel.compat import shard_map
 
     def f(x):
         local = x.reshape(-1)
@@ -197,9 +198,9 @@ def test_collectives_ring(mesh8):
         return total, ringed, passed
 
     x = jnp.arange(8.0).reshape(8, 1)
-    shard = jax.shard_map(f, mesh=mesh8, in_specs=P("data"),
-                          out_specs=(P(), P("data"), P("data")),
-                          check_vma=False)
+    shard = shard_map(f, mesh=mesh8, in_specs=P("data"),
+                      out_specs=(P(), P("data"), P("data")),
+                      check_vma=False)
     total, ringed, passed = shard(x)
     assert float(total[0]) == 28.0
     np.testing.assert_allclose(np.asarray(ringed).ravel(), [28.0] * 8)

@@ -46,6 +46,9 @@ Configs (order = bank cheap+judged numbers first, riskiest last):
   serving_batching  query-server hot path: concurrent-client sweep
                     (1/8/64) over the bucketed, pipelined micro-batcher,
                     p50/p99 + mean batch size + compile-shape ledger
+  deploy_swap       deploy lifecycle cutover: cold reload vs warm swap
+                    first-traffic latency + post-swap compile counts
+                    (warm must be ZERO — the deploy/ acceptance bar)
   als_ml20m         MovieLens-20M ALS on one chip: 20M ratings,
                     138k x 27k, string-id assignment + data build +
                     train + RMSE all timed (north star, BASELINE.md)
@@ -956,6 +959,175 @@ def cfg_serving_batching(jax, mesh, platform):
     return detail
 
 
+def cfg_deploy_swap(jax, mesh, platform):
+    """Deploy lifecycle cutover: cold reload vs warm swap.
+
+    A retrain must reach production without a compile stall — the warm
+    path (deploy/warm.py) drives the candidate through the ops/bucketing
+    shape ladder BEFORE cutover, so post-swap traffic hits only
+    pre-compiled shapes. Measured per cycle, each with a FRESH catalog
+    size (fresh shape keys => real compiles to pay somewhere):
+
+      * cold: swap with warmup disabled, then time first-traffic bursts
+        across the bucket ladder (they stall on serving-path compiles)
+        and read the pio_jax_compile_total delta.
+      * warm: same-shaped candidate warmed pre-cutover; same bursts.
+        The compile delta across the swap MUST be zero (asserted — the
+        acceptance criterion of the deploy subsystem).
+    """
+    import asyncio
+    import functools
+
+    import predictionio_tpu.models.als as als_mod
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from predictionio_tpu.core.engine import Engine, TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.deploy.warm import ServingUnit, warmup_unit
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, Query, RecommendationServing)
+    from predictionio_tpu.models.als import ALSModel
+    from predictionio_tpu.obs.jax_stats import compile_counter
+    from predictionio_tpu.obs.registry import default_registry
+    from predictionio_tpu.server.query_server import create_query_server
+    from predictionio_tpu.storage.base import EngineInstance
+    from predictionio_tpu.utils.server_config import (
+        DeployConfig, ServingConfig)
+
+    nu = int(os.environ.get("BENCH_DEPLOY_USERS", 3000))
+    ni_base = int(os.environ.get("BENCH_DEPLOY_ITEMS", 1500))
+    cycles = int(os.environ.get("BENCH_DEPLOY_CYCLES", 3))
+    rank, max_batch, num = 32, 16, 8
+    rng = np.random.default_rng(17)
+
+    def make_model(ni):
+        return ALSModel(
+            user_vocab=np.asarray([f"u{i:06d}" for i in range(nu)],
+                                  dtype=object),
+            item_vocab=np.asarray([f"i{i:06d}" for i in range(ni)],
+                                  dtype=object),
+            U=rng.normal(size=(nu, rank)).astype(np.float32),
+            V=rng.normal(size=(ni, rank)).astype(np.float32))
+
+    def make_unit(ni, tag):
+        return ServingUnit(
+            instance=EngineInstance(id=f"bench-{tag}-{ni}",
+                                    engine_id="bench", engine_version="1",
+                                    engine_variant="default"),
+            result=TrainResult(models=[make_model(ni)],
+                               algorithms=[ALSAlgorithm(AlgorithmParams())],
+                               serving=RecommendationServing(),
+                               engine_params=EngineParams()),
+            ctx=None, vectorized=True)
+
+    def total_compiles():
+        return sum(v for _l, v in
+                   compile_counter(default_registry()).samples())
+
+    engine = Engine({}, {}, {"als": ALSAlgorithm}, {})
+    server = create_query_server(
+        engine, make_unit(ni_base, "incumbent").result,
+        EngineInstance(id="bench-incumbent", engine_id="bench",
+                       engine_version="1", engine_variant="default"),
+        None,
+        serving_config=ServingConfig(batch_max=max_batch,
+                                     batch_linger_s=0.0, batch_inflight=2),
+        deploy_config=DeployConfig(warmup=True, drain_timeout_s=5.0))
+
+    ladder = [1, 2, 4, 8, 16]
+    out = {"cold": [], "warm": []}
+
+    async def burst(c, b, user_base):
+        t0 = time.perf_counter()
+        resp = await asyncio.gather(*[
+            c.post("/queries.json",
+                   json={"user": f"u{(user_base + i) % nu:06d}",
+                         "num": num}) for i in range(b)])
+        for r in resp:
+            assert r.status == 200, await r.text()
+            await r.json()
+        return time.perf_counter() - t0
+
+    async def cycle(c, ni, warm, tag):
+        unit = make_unit(ni, tag)
+        server._attach_batcher(unit)
+        predict_batch = functools.partial(server._predict_batch_unit, unit)
+        t0 = time.perf_counter()
+        if warm:
+            warmup_unit(unit, predict_batch, max_batch,
+                        query=Query(user="u000000", num=num))
+        prepare_s = time.perf_counter() - t0
+        compiles_before = total_compiles()
+        t0 = time.perf_counter()
+        server._swap_to(unit, "warm" if warm else "cold", "bench")
+        burst_s = [await burst(c, b, j * 101) for j, b in enumerate(ladder)]
+        first_traffic_s = time.perf_counter() - t0
+        return {
+            "prepare_s": prepare_s,
+            "first_traffic_s": first_traffic_s,
+            "worst_burst_s": max(burst_s),
+            "compile_delta": int(total_compiles() - compiles_before),
+        }
+
+    async def run_all():
+        c = TestClient(TestServer(server.app))
+        await c.start_server()
+        try:
+            await burst(c, 4, 0)           # incumbent warm-up / compile
+            ni = ni_base
+            for k in range(cycles):
+                for mode in ("cold", "warm"):
+                    ni += 7                # fresh catalog => fresh shapes
+                    hb(f"deploy_swap cycle {k} {mode} ni={ni}")
+                    out[mode].append(await cycle(c, ni, mode == "warm",
+                                                 f"{mode}{k}"))
+        finally:
+            await c.close()
+
+    # the host-BLAS crossover would hide the jit path on CPU; the shape
+    # discipline under test is the TPU-serving one
+    old_rt = als_mod._DEVICE_ROUNDTRIP_S
+    als_mod._DEVICE_ROUNDTRIP_S = 0.0
+    t0 = time.perf_counter()
+    try:
+        asyncio.run(run_all())
+    finally:
+        als_mod._DEVICE_ROUNDTRIP_S = old_rt
+    elapsed = time.perf_counter() - t0
+
+    warm_compiles = [c_["compile_delta"] for c_ in out["warm"]]
+    assert all(d == 0 for d in warm_compiles), (
+        f"warm swap paid post-cutover compiles: {warm_compiles}")
+    cold_ms = 1e3 * float(np.mean(
+        [c_["first_traffic_s"] for c_ in out["cold"]]))
+    warm_ms = 1e3 * float(np.mean(
+        [c_["first_traffic_s"] for c_ in out["warm"]]))
+    detail = {
+        "elapsed_s": round(elapsed, 3),
+        "baseline_s": None,
+        "cycles": cycles,
+        "cold_first_traffic_ms": round(cold_ms, 3),
+        "warm_first_traffic_ms": round(warm_ms, 3),
+        "cold_worst_burst_ms": round(1e3 * float(np.max(
+            [c_["worst_burst_s"] for c_ in out["cold"]])), 3),
+        "warm_worst_burst_ms": round(1e3 * float(np.max(
+            [c_["worst_burst_s"] for c_ in out["warm"]])), 3),
+        "warm_prepare_ms": round(1e3 * float(np.mean(
+            [c_["prepare_s"] for c_ in out["warm"]])), 3),
+        "cold_post_swap_compiles": int(np.sum(
+            [c_["compile_delta"] for c_ in out["cold"]])),
+        "warm_post_swap_compiles": int(np.sum(warm_compiles)),
+        "cutover_speedup": round(cold_ms / warm_ms, 2) if warm_ms else None,
+        "note": (f"{cycles} cold vs {cycles} warm swap cycles on fresh "
+                 f"{nu}x~{ni_base} r{rank} catalogs, ladder {ladder}; "
+                 f"first-traffic {cold_ms:.0f}ms cold vs {warm_ms:.0f}ms "
+                 "warm; warm pays its compiles pre-cutover "
+                 f"(prepare {1e3 * float(np.mean([c_['prepare_s'] for c_ in out['warm']])):.0f}ms) "
+                 "and ZERO after (asserted)"),
+    }
+    return detail
+
+
 def cfg_train_ingest(jax, mesh, platform):
     """Training-ingest hot path: event store -> model-ready arrays, the
     old per-Event fold vs the columnar pipeline (find_columnar +
@@ -1142,6 +1314,7 @@ CONFIGS = {
     "ecommerce_implicit_als": (cfg_ecommerce, 240),
     "eval_sweep_3fold_3rank": (cfg_eval_sweep, 420),
     "serving_batching": (cfg_serving_batching, 240),
+    "deploy_swap": (cfg_deploy_swap, 240),
     "train_ingest": (cfg_train_ingest, 240),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
@@ -1439,13 +1612,13 @@ class Suite:
 
 
 def orchestrate(names, partial=False):
-    # default covers the summed per-config budgets (3120s) PLUS worker
+    # default covers the summed per-config budgets (3360s) PLUS worker
     # init (INIT_BUDGET_S=420, possibly retried) so the tail config
     # (als_ml20m, the north star) is not skipped as "suite deadline" on a
     # slow-but-healthy chip; a pathologically slow claim + retry can still
     # eat into the tail, and if an outer driver timeout fires first the
     # SIGTERM handler dumps partials
-    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 3780))
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 4020))
     suite = Suite(names, deadline_s, partial=partial)
 
     def _sigterm(_sig, _frm):

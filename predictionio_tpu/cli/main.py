@@ -353,27 +353,46 @@ def train(variant, batch, skip_sanity_check, stop_after_read,
 @click.option("--port", default=8000, type=int)
 @click.option("--engine-instance-id", default=None,
               help="Deploy a specific instance instead of the latest.")
+@click.option("--release", "release_selector", default=None,
+              help="Deploy a specific release (id, version number or vN) "
+                   "from `pio releases`.")
 @click.option("--feedback", is_flag=True, help="Record query/prediction events.")
 @click.option("--event-server-app", default=None,
               help="App name for feedback events.")
 @click.option("--accesskey", default=None,
-              help="Key required for /stop and /reload.")
+              help="Key required for /stop, /reload and the deploy API.")
 @click.option("--log-url", default=None,
               help="POST serving errors to this URL "
                    "(CreateServer remoteLog).")
 @click.option("--log-prefix", default="",
               help="Prefix prepended to remote log payloads.")
-def deploy(variant, ip, port, engine_instance_id, feedback,
+def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
            event_server_app, accesskey, log_url, log_prefix):
     """Deploy the latest COMPLETED instance (Console.scala:260,
-    CreateServer.scala:109)."""
+    CreateServer.scala:109), or a pinned release via --release."""
+    from predictionio_tpu.deploy.releases import resolve_release
     from predictionio_tpu.server.query_server import run_query_server
     from predictionio_tpu.storage import Storage
     from predictionio_tpu.workflow.train import load_for_deploy
 
     engine, _, factory_path, variant_id = _load_engine_variant(variant)
     instances = Storage.get_meta_data_engine_instances()
-    if engine_instance_id:
+    release = None
+    if release_selector:
+        release = resolve_release(Storage.get_meta_data_releases(),
+                                  factory_path, "1", variant_id,
+                                  release_selector)
+        if release is None:
+            click.echo(f"[ERROR] Release {release_selector} not found "
+                       "(see `pio releases`). Aborting.")
+            sys.exit(1)
+        instance = instances.get(release.instance_id)
+        if instance is None or instance.status != "COMPLETED":
+            click.echo(f"[ERROR] Release v{release.version} points at "
+                       f"instance {release.instance_id}, which is not "
+                       "deployable. Aborting.")
+            sys.exit(1)
+    elif engine_instance_id:
         instance = instances.get(engine_instance_id)
         if instance is None or instance.status != "COMPLETED":
             click.echo(f"[ERROR] Engine instance {engine_instance_id} is not "
@@ -386,13 +405,92 @@ def deploy(variant, ip, port, engine_instance_id, feedback,
             click.echo("[ERROR] No COMPLETED engine instance found. "
                        "Run `pio train` first. Aborting.")
             sys.exit(1)
-    click.echo(f"[INFO] Deploying engine instance {instance.id} "
-               f"at {ip}:{port}")
+    if release is None:
+        release = _release_of_instance(factory_path, variant_id, instance.id)
+    click.echo(f"[INFO] Deploying engine instance {instance.id}"
+               + (f" (release v{release.version})" if release else "")
+               + f" at {ip}:{port}")
     result, ctx = load_for_deploy(engine, instance)
     run_query_server(engine, result, instance, ctx, ip=ip, port=port,
                      feedback=feedback, feedback_app_name=event_server_app,
                      access_key=accesskey, log_url=log_url,
-                     log_prefix=log_prefix)
+                     log_prefix=log_prefix, release=release)
+
+
+def _release_of_instance(engine_id, variant_id, instance_id):
+    """The release manifest registered for an instance, if any (pre-
+    release-registry instances deploy fine without one)."""
+    from predictionio_tpu.storage import Storage
+
+    try:
+        for r in Storage.get_meta_data_releases().get_for_variant(
+                engine_id, "1", variant_id):
+            if r.instance_id == instance_id:
+                return r
+    except Exception:
+        pass
+    return None
+
+
+@cli.command()
+@click.option("--variant", "-v", default="engine.json")
+@click.option("--status", "status_filter", default=None,
+              help="Only releases in this status (REGISTERED, CANARY, "
+                   "LIVE, RETIRED, ROLLED_BACK).")
+def releases(variant, status_filter):
+    """List release manifests for an engine variant (deploy/ registry)."""
+    from predictionio_tpu.storage import Storage
+
+    engine, _, factory_path, variant_id = _load_engine_variant(variant)
+    listing = Storage.get_meta_data_releases().get_for_variant(
+        factory_path, "1", variant_id)
+    if status_filter:
+        listing = [r for r in listing if r.status == status_filter.upper()]
+    click.echo(f"[INFO] {'Ver':<5} | {'Status':<11} | "
+               f"{'Instance':<32} | {'Created':<20} | Model")
+    for r in listing:
+        size = (f"{r.model_size_bytes / 1024:.0f}KiB"
+                if r.model_size_bytes else "-")
+        digest = r.model_digest[:12] if r.model_digest else "-"
+        click.echo(f"[INFO] v{r.version:<4} | {r.status:<11} | "
+                   f"{r.instance_id:<32} | "
+                   f"{r.created_time.strftime('%Y-%m-%d %H:%M:%S'):<20} | "
+                   f"{digest} {size}")
+    click.echo(f"[INFO] Finished listing {len(listing)} release(s).")
+
+
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=8000, type=int)
+@click.option("--accesskey", default=None)
+def rollback(ip, port, accesskey):
+    """Roll a live query server back to its previous release
+    (POST /rollback.json against the deploy API)."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{ip}:{port}/rollback.json"
+    if accesskey:
+        url += f"?accessKey={accesskey}"
+    try:
+        with urllib.request.urlopen(
+                urllib.request.Request(url, method="POST"),
+                timeout=60) as r:
+            out = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            message = json.loads(e.read().decode()).get("message", str(e))
+        except Exception:
+            message = str(e)
+        click.echo(f"[ERROR] Rollback failed: {message}")
+        sys.exit(1)
+    except Exception as e:
+        click.echo(f"[ERROR] Unable to reach query server: {e}")
+        sys.exit(1)
+    version = out.get("releaseVersion")
+    click.echo(f"[INFO] {out.get('message', 'Rolled back')}: now serving "
+               f"instance {out.get('engineInstanceId')}"
+               + (f" (release v{version})" if version else ""))
 
 
 @cli.command()

@@ -141,6 +141,16 @@ class Algorithm(Generic[PD, M, Q, P], abc.ABC):
         implementation where shapes allow."""
         return [(i, self.predict(model, q)) for i, q in queries]
 
+    def warmup_query(self, model: M) -> Optional[Q]:
+        """A representative query the deploy warm-swap ladder can drive
+        through this algorithm's scorers before a release takes traffic
+        (deploy/warm.py). Return None (the default) when no meaningful
+        query can be synthesized from the model alone — warmup then
+        falls back to the last live query or skips with a recorded
+        reason. No reference counterpart: the reference has no warmup
+        phase to feed."""
+        return None
+
     def make_persistent_model(self, ctx, model_id: str, algo_params: Any,
                               model: M) -> Any:
         """BaseAlgorithm.makePersistentModel:111 — return value semantics:

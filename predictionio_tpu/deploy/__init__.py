@@ -1,0 +1,57 @@
+"""Deployment lifecycle subsystem (L5.5): registry, warm swap, canary.
+
+The reference's deploy story ends at "load the latest COMPLETED instance
+and serve it" (CreateServer.scala:342-371 ReloadServer) — no release
+versioning, no pre-compile warmup, no staged rollout, no way back from a
+bad model. This package is the layer that makes a retrain safe to ship
+continuously:
+
+  * :mod:`releases` — versioned release manifests (content digests,
+    status lineage) written by ``run_train`` and persisted through the
+    storage SPI (``Storage.get_meta_data_releases``).
+  * :mod:`warm` — a release becomes a :class:`ServingUnit` (model +
+    vectorized-capability flag + batcher bundled into ONE atomically
+    swappable object) and is driven through the ``ops/bucketing`` shape
+    ladder BEFORE it takes traffic, so every bucketed batch shape is
+    compiled pre-cutover and the first post-swap batch pays zero XLA
+    compiles.
+  * :mod:`canary` — a deterministic traffic splitter routes a canary
+    fraction (or a score-but-discard shadow stream) to the candidate and
+    an SLO judge compares its sliding-window p99 latency and error rate
+    against the incumbent, auto-promoting or auto-rolling-back.
+
+Metric namespace: ``pio_deploy_*``; span namespace: ``deploy_*``
+(OBSERVABILITY.md has the full inventory).
+"""
+
+from predictionio_tpu.deploy.canary import (
+    CanaryConfig,
+    CanaryController,
+    SlidingStats,
+    TrafficSplitter,
+)
+from predictionio_tpu.deploy.releases import (
+    model_digest,
+    params_digest,
+    record_release,
+    resolve_release,
+)
+from predictionio_tpu.deploy.warm import (
+    DeployError,
+    ServingUnit,
+    WarmupReport,
+    build_unit,
+    deploy_metrics,
+    resolve_warmup_query,
+    verify_unit,
+    warmup_ladder,
+    warmup_unit,
+)
+
+__all__ = [
+    "CanaryConfig", "CanaryController", "SlidingStats", "TrafficSplitter",
+    "model_digest", "params_digest", "record_release", "resolve_release",
+    "DeployError", "ServingUnit", "WarmupReport", "build_unit",
+    "deploy_metrics", "resolve_warmup_query", "verify_unit",
+    "warmup_ladder", "warmup_unit",
+]

@@ -1,0 +1,188 @@
+"""Staged rollout: deterministic traffic splitting + SLO-guarded judging.
+
+A canary deploy routes a configured fraction of live queries to the
+candidate release while the incumbent serves the rest; a shadow deploy
+routes NOTHING user-visible to the candidate but mirrors queries into it
+and discards the results. Either way the judge compares the candidate's
+sliding-window p99 latency and error rate against the incumbent's and
+returns one of three verdicts after every observation:
+
+  * ``rollback`` — the candidate breached an SLO guard (its error rate
+    exceeds the incumbent's by more than `error_rate_slack`, or its p99
+    exceeds `p99_ratio` x incumbent p99 + `latency_slack_s`).
+  * ``promote`` — the candidate absorbed `promote_after` judged samples
+    without a breach.
+  * ``None`` — keep canarying.
+
+The splitter is error-diffusion rather than RNG: an accumulator gains
+`fraction` per query and emits a canary route every time it crosses 1,
+so the realized split is exact over any window and tests are
+deterministic. Windows are sample-count bounded (not wall-clock): a
+sliding deque per arm, so an early latency spike ages out instead of
+poisoning the whole canary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+from typing import Deque, Optional, Tuple
+
+#: serving roles a query can be scored under
+ROLE_INCUMBENT = "incumbent"
+ROLE_CANARY = "canary"
+ROLE_SHADOW = "shadow"
+
+
+@dataclasses.dataclass
+class CanaryConfig:
+    """Knobs for one staged rollout (defaults from
+    ``utils.server_config.DeployConfig``; per-deploy overrides ride the
+    POST /deploy.json body)."""
+
+    fraction: float = 0.1           # share of live traffic to the canary
+    shadow: bool = False            # score-but-discard instead of serving
+    window: int = 200               # sliding per-arm sample window
+    min_samples: int = 20           # per arm before any SLO judgment
+    promote_after: int = 100        # breach-free canary samples to promote
+    p99_ratio: float = 2.0          # canary p99 <= incumbent p99 * ratio ...
+    latency_slack_s: float = 0.025  # ... + this absolute slack
+    error_rate_slack: float = 0.05  # canary err <= incumbent err + slack
+
+    #: a canary is judged AGAINST the incumbent, so the incumbent must
+    #: keep enough traffic to fill its SLO window — fraction clamps here
+    #: (want 100%? that's a plain deploy, not a canary)
+    MAX_FRACTION = 0.9
+
+    def normalized(self) -> "CanaryConfig":
+        out = dataclasses.replace(self)
+        out.fraction = min(max(float(out.fraction), 0.0),
+                           self.MAX_FRACTION)
+        out.window = max(1, int(out.window))
+        out.min_samples = max(1, min(int(out.min_samples), out.window))
+        out.promote_after = max(out.min_samples, int(out.promote_after))
+        return out
+
+
+class TrafficSplitter:
+    """Deterministic error-diffusion split: over any N queries, exactly
+    ``round(N * fraction)`` (±1) route to the canary — no RNG, so the
+    integration tests and the realized fraction are both exact."""
+
+    def __init__(self, fraction: float):
+        self.fraction = min(max(fraction, 0.0), 1.0)
+        self._acc = 0.0
+
+    def route(self) -> bool:
+        """True -> this query goes to the canary."""
+        self._acc += self.fraction
+        if self._acc >= 1.0:
+            self._acc -= 1.0
+            return True
+        return False
+
+
+class SlidingStats:
+    """Bounded latency/error window for one serving arm."""
+
+    def __init__(self, window: int):
+        self._lat: Deque[float] = deque(maxlen=max(1, window))
+        self._err: Deque[bool] = deque(maxlen=max(1, window))
+        self.total = 0
+
+    def observe(self, seconds: float, ok: bool) -> None:
+        self.total += 1
+        self._err.append(not ok)
+        if ok:
+            # failed queries have no meaningful serving latency; they
+            # count against the error SLO instead
+            self._lat.append(seconds)
+
+    def count(self) -> int:
+        return len(self._err)
+
+    def error_rate(self) -> float:
+        if not self._err:
+            return 0.0
+        return sum(self._err) / len(self._err)
+
+    def p99(self) -> float:
+        return self.quantile(0.99)
+
+    def quantile(self, q: float) -> float:
+        if not self._lat:
+            return 0.0
+        ordered = sorted(self._lat)
+        rank = min(len(ordered) - 1,
+                   max(0, math.ceil(q * len(ordered)) - 1))
+        return ordered[rank]
+
+    def to_dict(self) -> dict:
+        return {"samples": self.count(), "total": self.total,
+                "errorRate": round(self.error_rate(), 4),
+                "p50Sec": round(self.quantile(0.50), 6),
+                "p99Sec": round(self.p99(), 6)}
+
+
+class CanaryController:
+    """The SLO judge for one candidate release.
+
+    Fed every query observation by the serving loop; returns a (verdict,
+    reason) pair once, after which it is `decided` and inert (the server
+    acts on the verdict exactly once).
+    """
+
+    def __init__(self, config: CanaryConfig):
+        self.config = config.normalized()
+        self.splitter = TrafficSplitter(
+            0.0 if self.config.shadow else self.config.fraction)
+        self.incumbent = SlidingStats(self.config.window)
+        self.canary = SlidingStats(self.config.window)
+        self.decided: Optional[Tuple[str, str]] = None
+
+    def observe(self, role: str, seconds: float, ok: bool
+                ) -> Optional[Tuple[str, str]]:
+        """Record one query outcome; returns the verdict the first time
+        one is reached, None otherwise."""
+        if role == ROLE_INCUMBENT:
+            self.incumbent.observe(seconds, ok)
+        else:                      # canary and shadow judge identically
+            self.canary.observe(seconds, ok)
+        if self.decided is not None:
+            return None
+        verdict = self._judge()
+        if verdict is not None:
+            self.decided = verdict
+        return verdict
+
+    def _judge(self) -> Optional[Tuple[str, str]]:
+        cfg = self.config
+        inc, can = self.incumbent, self.canary
+        if can.count() < cfg.min_samples or inc.count() < cfg.min_samples:
+            return None
+        can_err, inc_err = can.error_rate(), inc.error_rate()
+        if can_err > inc_err + cfg.error_rate_slack:
+            return ("rollback",
+                    f"slo_errors: canary {can_err:.3f} > incumbent "
+                    f"{inc_err:.3f} + {cfg.error_rate_slack}")
+        can_p99, inc_p99 = can.p99(), inc.p99()
+        if can_p99 > inc_p99 * cfg.p99_ratio + cfg.latency_slack_s:
+            return ("rollback",
+                    f"slo_latency: canary p99 {can_p99 * 1e3:.1f}ms > "
+                    f"incumbent p99 {inc_p99 * 1e3:.1f}ms x {cfg.p99_ratio} "
+                    f"+ {cfg.latency_slack_s * 1e3:.0f}ms")
+        if can.total >= cfg.promote_after:
+            return ("promote", "healthy: SLO window clean")
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "fraction": self.splitter.fraction,
+            "shadow": self.config.shadow,
+            "decided": list(self.decided) if self.decided else None,
+            "incumbent": self.incumbent.to_dict(),
+            "canary": self.canary.to_dict(),
+            "promoteAfter": self.config.promote_after,
+            "minSamples": self.config.min_samples,
+        }

@@ -142,7 +142,17 @@ class NaiveBayesParams(Params):
     reg: float = 1.0
 
 
-class NaiveBayesAlgorithm(Algorithm):
+class _WarmableClassifier(Algorithm):
+    """Shared deploy warm-swap probe: the attr vector is dense floats, so
+    a zero query exercises the full vectorized scorer (deploy/warm.py)."""
+
+    def warmup_query(self, model) -> Optional[Query]:
+        if model is None:
+            return None
+        return Query(attr0=0.0, attr1=0.0, attr2=0.0)
+
+
+class NaiveBayesAlgorithm(_WarmableClassifier):
     params_class = NaiveBayesParams
 
     def __init__(self, params: Optional[NaiveBayesParams] = None):
@@ -174,7 +184,7 @@ class LogisticRegressionParams(Params):
     seed: int = 0
 
 
-class LogisticRegressionAlgorithm(Algorithm):
+class LogisticRegressionAlgorithm(_WarmableClassifier):
     params_class = LogisticRegressionParams
 
     def __init__(self, params: Optional[LogisticRegressionParams] = None):
@@ -206,7 +216,7 @@ class LogisticRegressionAlgorithm(Algorithm):
 RandomForestParams = ForestParams
 
 
-class RandomForestAlgorithm(Algorithm):
+class RandomForestAlgorithm(_WarmableClassifier):
     """RandomForestAlgorithm.scala parity on the vmapped histogram-split
     forest (models/forest.py)."""
 

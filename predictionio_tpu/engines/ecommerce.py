@@ -261,6 +261,12 @@ class ECommAlgorithm(Algorithm):
                for i in order if scores[int(i)] > 0]
         return PredictedResult(item_scores=out)
 
+    def warmup_query(self, model: ECommModel) -> Optional[Query]:
+        """Deploy warm-swap probe (deploy/warm.py shape ladder)."""
+        if model is None or not len(model.user_vocab):
+            return None
+        return Query(user=str(model.user_vocab[0]), num=10)
+
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         black = self._gen_black_list(query)
         ok = self._candidate_mask(model, query, black)

@@ -330,6 +330,13 @@ class ALSAlgorithm(Algorithm):
         return PredictedResult(
             item_scores=[ItemScore(item=i, score=s) for i, s in recs])
 
+    def warmup_query(self, model: ALSModel) -> Optional[Query]:
+        """Deploy warm-swap probe: any known user exercises the full
+        bucketed top-k scorer family (deploy/warm.py shape ladder)."""
+        if model is None or not len(model.user_vocab):
+            return None
+        return Query(user=str(model.user_vocab[0]), num=10)
+
     def batch_predict(self, model: ALSModel, queries):
         """Vectorized: one device matmul for the whole batch — the eval /
         micro-batch fast path (vs CreateServer.scala:508 serial loop)."""

@@ -210,6 +210,12 @@ class ALSAlgorithm(Algorithm):
         V = V / np.where(norms == 0, 1.0, norms)
         return RecommendedUserModel(user_vocab=t_vocab, V=V, users=pd.users)
 
+    def warmup_query(self, model: RecommendedUserModel) -> Optional[Query]:
+        """Deploy warm-swap probe (deploy/warm.py shape ladder)."""
+        if model is None or not len(model.user_vocab):
+            return None
+        return Query(users=(str(model.user_vocab[0]),), num=10)
+
     def predict(self, model: RecommendedUserModel,
                 query: Query) -> PredictedResult:
         query_idx = {i for i in (model.user_index(u) for u in query.users)

@@ -241,6 +241,13 @@ class ALSAlgorithm(Algorithm):
         return SimilarityModel(item_vocab=item_vocab, V=V,
                                items=item_meta_join(item_vocab, pd.items))
 
+    def warmup_query(self, model: SimilarityModel) -> Optional[Query]:
+        """Deploy warm-swap probe: any catalog item drives the batched
+        cosine scorer through the bucket ladder (deploy/warm.py)."""
+        if model is None or not len(model.item_vocab):
+            return None
+        return Query(items=(str(model.item_vocab[0]),), num=10)
+
     def predict(self, model: SimilarityModel, query: Query) -> PredictedResult:
         query_idx = {i for i in (model.item_index(x) for x in query.items)
                      if i is not None}
@@ -320,6 +327,11 @@ class CooccurrenceAlgorithm(Algorithm):
                                   top_cooccurrences=top)
         return CooccurrenceEngineModel(
             model=model, items=item_meta_join(item_vocab, pd.items))
+
+    def warmup_query(self, m: CooccurrenceEngineModel) -> Optional[Query]:
+        if m is None or not len(m.model.item_vocab):
+            return None
+        return Query(items=(str(m.model.item_vocab[0]),), num=10)
 
     def predict(self, m: CooccurrenceEngineModel, query: Query
                 ) -> PredictedResult:

@@ -50,6 +50,8 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         logger.info("single-process run; jax.distributed not initialized")
         _initialized = True
         return
+    if (num_processes or 0) > 1:
+        _enable_cpu_collectives(jax)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -57,6 +59,27 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     logger.info("jax.distributed initialized: process %s/%s",
                 jax.process_index(), jax.process_count())
     _initialized = True
+
+
+def _enable_cpu_collectives(jax) -> None:
+    """Multi-process runs on the CPU backend need a cross-process
+    collectives transport: without one, the first computation over a
+    cross-process mesh dies with XLA's "Multiprocess computations aren't
+    implemented on the CPU backend". Newer jaxlibs ship a Gloo transport
+    behind ``jax_cpu_collectives_implementation``; select it BEFORE the
+    backend initializes (a no-op on TPU — the flag only affects the CPU
+    client). Best-effort: older jax versions without the flag keep their
+    previous behavior."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() not in ("", "cpu"):
+        return
+    for flag, value in (("jax_cpu_collectives_implementation", "gloo"),
+                        ("jax_cpu_enable_gloo_collectives", True)):
+        try:
+            jax.config.update(flag, value)
+            logger.info("CPU collectives transport: %s=%r", flag, value)
+            return
+        except (AttributeError, ValueError):
+            continue
 
 
 def process_count() -> int:

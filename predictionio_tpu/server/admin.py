@@ -8,6 +8,11 @@ and its CommandClient (tools/.../admin/CommandClient.scala):
   POST   /cmd/app               -> create app (body {"name": ..., "id"?, "description"?})
   DELETE /cmd/app/<name>        -> delete app + data
   DELETE /cmd/app/<name>/data   -> wipe app event data
+
+Deploy-lifecycle extension (no reference counterpart):
+
+  GET    /cmd/releases          -> all release manifests (deploy/ registry);
+                                   ?engineId=&engineVariant= filters
 """
 
 from __future__ import annotations
@@ -122,6 +127,23 @@ async def handle_app_data_delete(request):
         {"status": 0, "message": f"App {name} does not exist."}, status=404)
 
 
+async def handle_releases(request):
+    """Release manifests across every engine variant (the operator's
+    fleet view; the query server's /releases.json is per-variant)."""
+    from predictionio_tpu.deploy.releases import release_to_json
+
+    engine_id = request.query.get("engineId")
+    variant = request.query.get("engineVariant")
+
+    def _list():
+        return [release_to_json(r)
+                for r in Storage.get_meta_data_releases().get_all()
+                if (not engine_id or r.engine_id == engine_id)
+                and (not variant or r.engine_variant == variant)]
+
+    return web.json_response({"status": 1, "releases": await _run(_list)})
+
+
 def create_admin_server(registry: MetricsRegistry = None) -> web.Application:
     registry = registry or MetricsRegistry()
     app = web.Application(middlewares=[
@@ -131,6 +153,7 @@ def create_admin_server(registry: MetricsRegistry = None) -> web.Application:
     app.router.add_post("/cmd/app", handle_app_new)
     app.router.add_delete("/cmd/app/{name}", handle_app_delete)
     app.router.add_delete("/cmd/app/{name}/data", handle_app_data_delete)
+    app.router.add_get("/cmd/releases", handle_releases)
     add_metrics_routes(app, registry, default_registry())
     return app
 

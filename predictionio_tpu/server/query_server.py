@@ -5,9 +5,28 @@ Parity with the reference CreateServer/PredictionServer
 
   GET  /               -> engine/instance info + serving stats   (:460-482)
   POST /queries.json   -> the prediction hot path                (:484-605)
-  GET  /reload         -> reload latest COMPLETED instance       (:642-652)
+  GET  /reload         -> WARM-swap to latest COMPLETED instance (:642-652)
   POST /stop           -> graceful shutdown (key auth)           (:635-641)
   GET  /plugins.json   -> engine server plugin registry
+
+Deploy-lifecycle surface (deploy/ subsystem; no reference counterpart —
+the reference's /reload is a cold load-latest with no way back):
+
+  GET  /releases.json       -> release manifests for this variant
+  GET  /deploy/status.json  -> active release + canary window state
+  POST /deploy.json         -> warm deploy a release (key auth); body
+                               {"releaseId"|"version"|"engineInstanceId",
+                                "canaryFraction"?, "shadow"?, ...}
+  POST /rollback.json       -> roll back (key auth): abort an active
+                               canary, else restore the standby release
+
+Everything a query touches — TrainResult, the vectorized-capability
+flag, the micro-batcher — is bundled into one :class:`deploy.ServingUnit`
+and swapped as a single reference assignment, so an in-flight batch keeps
+the release it was routed to and no request can observe a half-swapped
+(result, vectorized) pair. Before a unit takes traffic it is driven
+through the ops/bucketing shape ladder (deploy/warm.py), so the first
+post-cutover batch pays zero XLA compiles.
 
 The hot path (:508 runs algorithms serially and says "TODO: Parallelize";
 SURVEY.md P7): here the model's factor matrices stay resident as device
@@ -30,7 +49,7 @@ import json
 import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 from aiohttp import web
 
@@ -38,15 +57,23 @@ from predictionio_tpu.core.engine import Engine, TrainResult
 from predictionio_tpu.core.params import params_from_json
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event, UTC
+from predictionio_tpu.deploy.canary import (
+    ROLE_CANARY, ROLE_INCUMBENT, ROLE_SHADOW, CanaryConfig, CanaryController,
+)
+from predictionio_tpu.deploy.releases import release_to_json, resolve_release
+from predictionio_tpu.deploy.warm import (
+    DeployError, ServingUnit, WarmupReport, build_unit, deploy_metrics,
+    verify_unit, warmup_unit,
+)
 from predictionio_tpu.obs.jax_stats import register_jax_metrics
 from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
 from predictionio_tpu.obs.tracing import span, span_histogram
 from predictionio_tpu.ops.bucketing import bucket_size, padding_waste
 from predictionio_tpu.server.plugins import PluginContext
-from predictionio_tpu.storage.base import EngineInstance, generate_id
+from predictionio_tpu.storage.base import EngineInstance, Release, generate_id
 from predictionio_tpu.storage.registry import Storage
-from predictionio_tpu.utils.server_config import ServingConfig
+from predictionio_tpu.utils.server_config import DeployConfig, ServingConfig
 
 logger = logging.getLogger("pio.queryserver")
 
@@ -308,6 +335,15 @@ class MicroBatcher:
                 fut.set_result(res)
 
 
+@dataclasses.dataclass
+class CanaryState:
+    """One in-flight staged rollout: the candidate unit plus its judge."""
+
+    unit: ServingUnit
+    controller: CanaryController
+    config: CanaryConfig
+
+
 class QueryServer:
     def __init__(self, engine: Engine, train_result: TrainResult,
                  instance: EngineInstance, ctx,
@@ -318,11 +354,10 @@ class QueryServer:
                  log_url: Optional[str] = None,
                  log_prefix: str = "",
                  registry: Optional[MetricsRegistry] = None,
-                 serving_config: Optional[ServingConfig] = None):
+                 serving_config: Optional[ServingConfig] = None,
+                 deploy_config: Optional[DeployConfig] = None,
+                 release: Optional[Release] = None):
         self.engine = engine
-        self.result = train_result
-        self.instance = instance
-        self.ctx = ctx
         self.feedback = feedback
         self.feedback_app_name = feedback_app_name
         #: remote error sink (CreateServer.scala:435-446 remoteLog): on a
@@ -345,6 +380,7 @@ class QueryServer:
         self.registry = registry or MetricsRegistry()
         register_jax_metrics(default_registry())
         self.serving_config = serving_config or ServingConfig.from_env()
+        self.deploy_config = deploy_config or DeployConfig.from_env()
         #: dedicated bounded pool for predictions ONLY — feedback writes
         #: and remote logging stay on the loop's default executor, so a
         #: burst of event-store writes can never starve the hot path (and
@@ -353,13 +389,11 @@ class QueryServer:
         self._predict_executor = ThreadPoolExecutor(
             max_workers=max(4, self.serving_config.batch_inflight * 2),
             thread_name_prefix="pio-predict")
-        self.batcher = MicroBatcher(
-            self._predict_batch,
-            max_batch=self.serving_config.batch_max,
-            linger_s=self.serving_config.batch_linger_s,
-            inflight=self.serving_config.batch_inflight,
-            executor=self._predict_executor,
-            registry=self.registry)
+        #: one background lane for deploy phases (load/warmup/verify):
+        #: a warmup compiling the whole shape ladder must never occupy a
+        #: predict slot of the incumbent
+        self._deploy_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pio-deploy")
         #: pre-resolved span-histogram handle for batch-stage timings
         #: (_predict_batch runs per batch on the executor — it must not
         #: take the registry lock to re-resolve the histogram each stage)
@@ -368,10 +402,25 @@ class QueryServer:
             "pio_batch_pad_waste_rows_total",
             "Throwaway rows added padding batches up to their shape "
             "bucket (the price of a bounded compile-shape set)")
-        #: cached per TrainResult (recomputing re-imported core.base and
-        #: re-walked every algorithm on EVERY request); refreshed when
-        #: /reload swaps the result
-        self._vectorized_cached = self._compute_vectorized(train_result)
+        self._deploy = deploy_metrics(self.registry)
+        #: THE serving state: everything a query touches, swapped as one
+        #: reference ('result' and 'vectorized' can never be observed
+        #: half-updated). The previous LIVE unit is kept resident as the
+        #: instant-rollback standby (blue/green).
+        self._unit = ServingUnit(
+            instance=instance, result=train_result, ctx=ctx,
+            vectorized=self._compute_vectorized(train_result),
+            release=release)
+        self._attach_batcher(self._unit)
+        self._standby: Optional[ServingUnit] = None
+        self._canary: Optional["CanaryState"] = None
+        #: strong refs to fire-and-forget deploy tasks (retire/verdict/
+        #: shadow) — the loop holds tasks weakly, so an unreferenced one
+        #: can be garbage-collected mid-flight
+        self._bg_tasks: set = set()
+        self._last_query = None          # warmup fallback for /reload
+        self._last_warmup: Optional[WarmupReport] = None
+        self._deploy.active_version.set(float(self._unit.release_version))
         self._query_hist = self.registry.histogram(
             "pio_query_duration_seconds",
             "Query hot-path wall time by engine variant",
@@ -393,11 +442,25 @@ class QueryServer:
         self._routes()
 
     async def _on_cleanup(self, app) -> None:
-        # drain the batcher BEFORE the executor goes away: its worker's
-        # finally fails queued queries fast instead of leaving a pending
-        # task (and a 'Task was destroyed' warning) behind the loop
-        await self.batcher.shutdown()
+        # settle the deploy background tasks first (a mid-drain
+        # _retire_batcher would otherwise die as a destroyed-pending task)
+        for task in list(self._bg_tasks):
+            task.cancel()
+        if self._bg_tasks:
+            await asyncio.gather(*self._bg_tasks, return_exceptions=True)
+        # then drain every batcher still alive — active, canary, AND a
+        # standby whose retirement the cancel above interrupted — BEFORE
+        # the executor goes away: their workers' finally fails queued
+        # queries fast instead of leaving a pending task (and a 'Task
+        # was destroyed' warning) behind the loop
+        units = list(self._live_units())
+        if self._standby is not None:
+            units.append(self._standby)
+        for unit in units:
+            if unit.batcher is not None:
+                await unit.batcher.shutdown()
         self._predict_executor.shutdown(wait=False)
+        self._deploy_executor.shutdown(wait=False)
 
     def _routes(self):
         r = self.app.router
@@ -406,7 +469,68 @@ class QueryServer:
         r.add_get("/reload", self.handle_reload)
         r.add_post("/stop", self.handle_stop)
         r.add_get("/plugins.json", self.handle_plugins)
+        r.add_get("/releases.json", self.handle_releases)
+        r.add_get("/deploy/status.json", self.handle_deploy_status)
+        r.add_post("/deploy.json", self.handle_deploy)
+        r.add_post("/rollback.json", self.handle_rollback)
         add_metrics_routes(self.app, self.registry, default_registry())
+
+    # -- serving-unit plumbing (deploy/ subsystem) ---------------------------
+    @property
+    def result(self) -> TrainResult:
+        return self._unit.result
+
+    @property
+    def instance(self) -> EngineInstance:
+        return self._unit.instance
+
+    @property
+    def ctx(self):
+        return self._unit.ctx
+
+    @property
+    def batcher(self) -> MicroBatcher:
+        return self._unit.batcher
+
+    @property
+    def _vectorized_cached(self) -> bool:
+        return self._unit.vectorized
+
+    @_vectorized_cached.setter
+    def _vectorized_cached(self, value: bool) -> None:
+        self._unit.vectorized = value
+
+    def _attach_batcher(self, unit: ServingUnit) -> None:
+        """Give a unit its own micro-batcher closed over ITS result —
+        batches drained after a swap still score on the release they
+        were routed to."""
+        unit.batcher = MicroBatcher(
+            functools.partial(self._predict_batch_unit, unit),
+            max_batch=self.serving_config.batch_max,
+            linger_s=self.serving_config.batch_linger_s,
+            inflight=self.serving_config.batch_inflight,
+            executor=self._predict_executor,
+            registry=self.registry)
+        # each MicroBatcher points the depth gauge at itself; the server
+        # owns the truth: queued queries across every live unit
+        self.registry.gauge_callback(
+            "pio_batch_queue_depth",
+            "Queries waiting in the micro-batch queue",
+            lambda: float(sum(
+                u.batcher.queue_depth()
+                for u in self._live_units() if u.batcher is not None)))
+
+    def _live_units(self) -> List[ServingUnit]:
+        units = [self._unit]
+        if self._canary is not None:
+            units.append(self._canary.unit)
+        return units
+
+    def _spawn(self, coro) -> None:
+        """create_task with a strong reference held until completion."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     # -- info ---------------------------------------------------------------
     async def handle_root(self, request):
@@ -422,6 +546,7 @@ class QueryServer:
                 "engineId": self.instance.engine_id,
                 "engineVariant": self.instance.engine_variant,
                 "startTime": self.instance.start_time.isoformat(),
+                "releaseVersion": self._unit.release_version or None,
             },
             "algorithms": [type(a).__name__ for a in self.result.algorithms],
             "startTime": self.start_time.isoformat(),
@@ -464,22 +589,25 @@ class QueryServer:
             self._query_failures.inc(engine_variant=variant,
                                      reason="bad_json")
             return web.json_response({"message": str(e)}, status=400)
+        # route: snapshot the unit ONCE — everything this request touches
+        # (result, vectorized flag, batcher) rides that one reference, so
+        # a concurrent swap can never hand it mismatched halves
+        role, unit, canary = ROLE_INCUMBENT, self._unit, self._canary
+        if canary is not None and canary.controller.decided is None \
+                and canary.controller.splitter.route():
+            role, unit = ROLE_CANARY, canary.unit
+        t_predict = time.perf_counter()
         try:
             # spans resolve through the middleware-installed trace, which
             # carries a pre-resolved histogram handle (no lock on hot path)
             with span("extract_query"):
                 query = self._extract_query(body)
+            self._last_query = query      # warmup fallback for /reload
             with span("predict"):
-                if self._vectorized():
-                    prediction = await self.batcher.submit(query)
-                else:
-                    # no vectorized batch_predict to exploit — per-request
-                    # parallelism on the server's own bounded pool beats
-                    # serializing into one batch
-                    loop = asyncio.get_running_loop()
-                    prediction = await loop.run_in_executor(
-                        self._predict_executor, self._predict, query)
+                prediction = await self._predict_via(unit, query)
         except Exception as e:
+            self._observe_role(canary, role,
+                               time.perf_counter() - t_predict, ok=False)
             logger.exception("query failed")
             self._query_failures.inc(engine_variant=variant,
                                      reason="predict_error")
@@ -487,6 +615,13 @@ class QueryServer:
                 await self._remote_log(
                     f"Query:\n{json.dumps(body)}\n\nError:\n{e!r}\n\n")
             return web.json_response({"message": str(e)}, status=400)
+        self._observe_role(canary, role,
+                           time.perf_counter() - t_predict, ok=True)
+        if (canary is not None and canary.config.shadow
+                and canary.controller.decided is None):
+            # shadow mode: mirror the query into the candidate off the
+            # response path; its result is scored for SLOs and discarded
+            self._spawn(self._shadow_score(canary, query))
 
         pred_json = _to_jsonable(prediction)
         # feedback loop: tag with prId and record events (:527-589)
@@ -521,10 +656,49 @@ class QueryServer:
             return body
         return params_from_json(body, qc)
 
+    async def _predict_via(self, unit: ServingUnit, query):
+        """Score one query on a specific serving unit (incumbent or
+        canary): through ITS batcher when vectorized, else per-request
+        on the predict pool."""
+        if unit.vectorized:
+            return await unit.batcher.submit(query)
+        # no vectorized batch_predict to exploit — per-request
+        # parallelism on the server's own bounded pool beats
+        # serializing into one batch
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._predict_executor, self._predict_unit, unit, query)
+
+    def _observe_role(self, canary: Optional["CanaryState"], role: str,
+                      seconds: float, ok: bool) -> None:
+        """Per-role accounting: every query increments
+        pio_deploy_requests_total, and during a staged rollout feeds the
+        SLO judge — whose verdict (promote/rollback) is acted on off the
+        request path."""
+        self._deploy.requests_total.inc(role=role)
+        if canary is None or canary is not self._canary:
+            return
+        verdict = canary.controller.observe(role, seconds, ok)
+        if verdict is not None:
+            self._spawn(self._act_on_verdict(canary, verdict))
+
+    async def _shadow_score(self, canary: "CanaryState", query) -> None:
+        """Score-but-discard: the candidate sees real traffic shape
+        without serving a single user-visible byte."""
+        t0 = time.perf_counter()
+        try:
+            await self._predict_via(canary.unit, query)
+            ok = True
+        except Exception:
+            ok = False
+        self._observe_role(canary, ROLE_SHADOW,
+                           time.perf_counter() - t0, ok)
+
     def _vectorized(self) -> bool:
-        """Cached per TrainResult — the walk itself is cheap but it sat
-        on EVERY request; recomputed only when /reload swaps models."""
-        return self._vectorized_cached
+        """Cached per ServingUnit — the walk itself is cheap but it sat
+        on EVERY request; recomputed only when a swap installs a new
+        unit."""
+        return self._unit.vectorized
 
     @staticmethod
     def _compute_vectorized(result: TrainResult) -> bool:
@@ -540,14 +714,23 @@ class QueryServer:
             for a in result.algorithms)
 
     def _predict(self, query):
-        supplemented = self.result.serving.supplement(query)
+        return self._predict_unit(self._unit, query)
+
+    def _predict_unit(self, unit: ServingUnit, query):
+        result = unit.result
+        supplemented = result.serving.supplement(query)
         predictions = [
             algo.predict(model, supplemented)
-            for algo, model in zip(self.result.algorithms, self.result.models)]
-        return self.result.serving.serve(query, predictions)
+            for algo, model in zip(result.algorithms, result.models)]
+        return result.serving.serve(query, predictions)
 
     def _predict_batch(self, queries):
-        """Batch path behind MicroBatcher (runs on the predict executor).
+        """Active-unit batch path (tests/bench call this directly)."""
+        return self._predict_batch_unit(self._unit, queries)
+
+    def _predict_batch_unit(self, unit: ServingUnit, queries):
+        """Batch path behind each unit's MicroBatcher (runs on the
+        predict executor).
 
         Per-query errors are isolated: a failing query yields its
         Exception in the result slot, never poisoning the rest of the
@@ -565,7 +748,7 @@ class QueryServer:
         rows (unknown users shrink B mid-model, so it must); for
         host-BLAS scorers the pad is a few microseconds of duplicated
         matvec — the bounded price of one rule for every engine."""
-        result = self.result      # snapshot: /reload may swap mid-batch
+        result = unit.result      # the unit IS the swap-consistency unit
         n = len(queries)
         out = [None] * n
         ok = []
@@ -577,7 +760,7 @@ class QueryServer:
                     out[i] = e
             if not ok:
                 return out
-            bucket = bucket_size(len(ok), self.batcher.max_batch)
+            bucket = bucket_size(len(ok), self.serving_config.batch_max)
             waste = padding_waste(len(ok), bucket)
             if waste:
                 # sentinel indices >= n mark pad rows; their predictions
@@ -637,33 +820,445 @@ class QueryServer:
             return True
         return request.query.get("accessKey") == self.access_key
 
+    # -- deploy lifecycle (deploy/ subsystem) --------------------------------
+    def _effective_warmup(self, override: Optional[bool]) -> bool:
+        """The warmup flag a prepare actually ran with: a per-deploy body
+        override beats DeployConfig — the swap-mode metric label must
+        agree with it."""
+        return bool(self.deploy_config.warmup if override is None
+                    else override)
+
+    def _phase_timer(self, phase: str):
+        """Time one deploy phase into the pio_deploy phase histogram AND
+        the request trace (deploy_<phase> span)."""
+        @contextlib.contextmanager
+        def _cm():
+            t0 = time.perf_counter()
+            with span(f"deploy_{phase}"):
+                try:
+                    yield
+                finally:
+                    self._deploy.phase_hist.observe(
+                        time.perf_counter() - t0, phase=phase)
+        return _cm()
+
+    async def _prepare_unit(self, instance: EngineInstance,
+                            release: Optional[Release],
+                            warmup: Optional[bool] = None,
+                            warmup_query_json: Optional[dict] = None
+                            ) -> ServingUnit:
+        """The pre-cutover pipeline: load -> warmup -> verify, all on the
+        deploy lane so the incumbent never donates a predict slot. The
+        returned unit is fully compiled and health-checked but NOT yet
+        taking traffic."""
+        loop = asyncio.get_running_loop()
+        with self._phase_timer("load"):
+            unit = await loop.run_in_executor(
+                self._deploy_executor, build_unit, self.engine, instance,
+                release)
+        self._attach_batcher(unit)
+        predict_batch = functools.partial(self._predict_batch_unit, unit)
+        explicit_q = None
+        if warmup_query_json is not None:
+            explicit_q = self._extract_query(warmup_query_json)
+        warm = self._effective_warmup(warmup)
+        if warm:
+            with self._phase_timer("warmup"):
+                report = await loop.run_in_executor(
+                    self._deploy_executor, warmup_unit, unit, predict_batch,
+                    self.serving_config.batch_max,
+                    explicit_q if explicit_q is not None else self._last_query)
+            self._deploy.warmup_shapes.inc(len(report.buckets))
+            self._last_warmup = report
+            logger.info("warmup for instance %s: buckets=%s compiles=%d "
+                        "(%.3fs)%s", instance.id, report.buckets,
+                        report.compile_delta, report.seconds,
+                        f" skipped={report.skipped}" if report.skipped else "")
+        else:
+            self._last_warmup = WarmupReport(skipped="disabled")
+        with self._phase_timer("verify"):
+            await loop.run_in_executor(
+                self._deploy_executor, verify_unit, unit, predict_batch,
+                explicit_q if explicit_q is not None else self._last_query)
+        return unit
+
+    def _swap_to(self, unit: ServingUnit, mode: str, reason: str,
+                 retire_old: bool = True) -> None:
+        """THE cutover: one reference assignment installs the new unit;
+        the old unit becomes the instant-rollback standby and its batcher
+        drains in the background. ``retire_old=False`` leaves the
+        outgoing unit's release status to the caller (rollback marks it
+        ROLLED_BACK, not RETIRED)."""
+        old = self._unit
+        with self._phase_timer("swap"):
+            self._unit = unit
+        self._deploy.swap_total.inc(mode=mode, outcome="ok")
+        self._deploy.active_version.set(float(unit.release_version))
+        self._standby = old
+        self._spawn(self._retire_batcher(old))
+        self._set_release_status(unit.release, "LIVE", reason)
+        if retire_old and old.release is not None and (
+                unit.release is None or old.release.id != unit.release.id):
+            self._set_release_status(old.release, "RETIRED",
+                                     f"superseded: {reason}")
+        logger.info("swapped to engine instance %s (%s: %s)",
+                    unit.instance.id, mode, reason)
+
+    async def _retire_batcher(self, unit: ServingUnit,
+                              timeout: Optional[float] = None) -> None:
+        """Graceful retirement: already-routed batches drain on the old
+        unit's own batcher (they score on the release they were promised)
+        before the worker is torn down. Aborts if the unit was promoted
+        back to live mid-drain (a rollback inside the drain window must
+        not tear down the batcher now serving traffic)."""
+        batcher = unit.batcher
+        if batcher is None:
+            return
+
+        def _reinstated() -> bool:
+            return unit is self._unit or unit.batcher is not batcher
+
+        t0 = time.perf_counter()
+        deadline = t0 + (timeout if timeout is not None
+                         else self.deploy_config.drain_timeout_s)
+        while (batcher.queue_depth() > 0 or batcher._inflight_now > 0) \
+                and time.perf_counter() < deadline:
+            if _reinstated():
+                return
+            await asyncio.sleep(0.02)
+        if _reinstated():
+            return
+        await batcher.shutdown()
+        if unit.batcher is batcher:
+            unit.batcher = None
+        self._deploy.phase_hist.observe(time.perf_counter() - t0,
+                                        phase="drain")
+
+    def _set_release_status(self, release: Optional[Release], status: str,
+                            reason: str) -> None:
+        """Best-effort lineage write-back (off-thread; a registry outage
+        must never wedge serving)."""
+        if release is None:
+            return
+
+        def _write():
+            try:
+                Storage.get_meta_data_releases().set_status(
+                    release.id, status, reason=reason)
+            except Exception:
+                logger.exception("release status update failed (%s -> %s)",
+                                 release.id, status)
+        release.status = status          # keep the resident copy honest
+        try:
+            asyncio.get_running_loop().run_in_executor(None, _write)
+        except RuntimeError:             # no loop (tests calling directly)
+            _write()
+
+    async def _act_on_verdict(self, canary: "CanaryState",
+                              verdict) -> None:
+        decision, reason = verdict
+        if self._canary is not canary:
+            return
+        self._canary = None
+        self._deploy.canary_fraction.set(0.0)
+        if decision == "promote":
+            self._deploy.promote_total.inc(
+                reason="healthy" if reason.startswith("healthy") else reason)
+            self._swap_to(canary.unit, mode="canary", reason=reason)
+        else:
+            slug = reason.split(":", 1)[0]
+            self._deploy.rollback_total.inc(reason=slug)
+            self._set_release_status(canary.unit.release, "ROLLED_BACK",
+                                     reason)
+            await self._retire_batcher(canary.unit)
+            logger.warning("canary rolled back: %s", reason)
+
     async def handle_reload(self, request):
-        """Re-read the latest COMPLETED instance (:342-371 ReloadServer)."""
+        """Warm-swap to the latest COMPLETED instance — "prepare new,
+        verify healthy, atomically swap, retire old" (the reference's
+        :342-371 ReloadServer reloaded cold, in place)."""
         if not self._authorized(request):
             return web.json_response({"message": "Unauthorized"}, status=401)
-        from predictionio_tpu.workflow.train import load_for_deploy
+        blocked = await self._settle_canary_first()
+        if blocked is not None:
+            return blocked
+        loop = asyncio.get_running_loop()
 
-        instances = Storage.get_meta_data_engine_instances()
-        latest = instances.get_latest_completed(
-            self.instance.engine_id, self.instance.engine_version,
-            self.instance.engine_variant)
+        def _lookup():
+            instances = Storage.get_meta_data_engine_instances()
+            latest = instances.get_latest_completed(
+                self.instance.engine_id, self.instance.engine_version,
+                self.instance.engine_variant)
+            release = None
+            if latest is not None:
+                try:
+                    releases = Storage.get_meta_data_releases()
+                    for r in releases.get_for_variant(
+                            latest.engine_id, latest.engine_version,
+                            latest.engine_variant):
+                        if r.instance_id == latest.id:
+                            release = r
+                            break
+                except Exception:
+                    logger.exception("release lookup failed")
+            return latest, release
+
+        latest, release = await loop.run_in_executor(None, _lookup)
         if latest is None:
             self._reload_total.inc(status="not_found")
             return web.json_response(
                 {"message": "No COMPLETED instance found"}, status=404)
-        loop = asyncio.get_running_loop()
-        result, ctx = await loop.run_in_executor(
-            None, load_for_deploy, self.engine, latest)
-        # swap under the running loop — double-buffered reload; the
-        # cached vectorized-capability flag refreshes with the swap
-        self.result = result
-        self._vectorized_cached = self._compute_vectorized(result)
-        self.ctx = ctx
-        self.instance = latest
+        mode = "warm" if self._effective_warmup(None) else "cold"
+        try:
+            unit = await self._prepare_unit(latest, release)
+        except DeployError as e:
+            self._reload_total.inc(status="failed")
+            self._deploy.swap_total.inc(mode=mode, outcome="failed")
+            return web.json_response({"message": str(e)}, status=500)
+        self._swap_to(unit, mode=mode, reason="reload")
         self._reload_total.inc(status="reloaded")
-        logger.info("reloaded engine instance %s", latest.id)
-        return web.json_response({"message": "Reloaded",
-                                  "engineInstanceId": latest.id})
+        return web.json_response({
+            "message": "Reloaded",
+            "engineInstanceId": latest.id,
+            "releaseVersion": unit.release_version or None,
+            "warmup": (self._last_warmup.to_dict()
+                       if self._last_warmup else None)})
+
+    async def handle_deploy(self, request):
+        """Warm-deploy a specific release: full cutover by default, a
+        canary/shadow rollout when the body asks for one."""
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError as e:
+            return web.json_response({"message": str(e)}, status=400)
+        blocked = await self._settle_canary_first()
+        if blocked is not None:
+            return blocked
+        loop = asyncio.get_running_loop()
+
+        def _resolve():
+            instances = Storage.get_meta_data_engine_instances()
+            release = None
+            if body.get("engineInstanceId"):
+                instance = instances.get(str(body["engineInstanceId"]))
+            else:
+                selector = body.get("releaseId") or body.get("version")
+                releases = Storage.get_meta_data_releases()
+                release = resolve_release(
+                    releases, self.instance.engine_id,
+                    self.instance.engine_version,
+                    self.instance.engine_variant,
+                    str(selector) if selector is not None else None)
+                instance = (instances.get(release.instance_id)
+                            if release is not None else None)
+            return instance, release
+
+        instance, release = await loop.run_in_executor(None, _resolve)
+        if instance is None or instance.status != "COMPLETED":
+            return web.json_response(
+                {"message": "No deployable release/instance matched."},
+                status=404)
+        mode = "warm" if self._effective_warmup(body.get("warmup")) \
+            else "cold"
+        try:
+            unit = await self._prepare_unit(
+                instance, release, warmup=body.get("warmup"),
+                warmup_query_json=body.get("warmupQuery"))
+        except DeployError as e:
+            self._deploy.swap_total.inc(mode=mode, outcome="failed")
+            self._set_release_status(release, "ROLLED_BACK",
+                                     f"prepare failed: {e}")
+            return web.json_response({"message": str(e)}, status=500)
+
+        cfg = self._canary_config(body)
+        if cfg is not None:
+            controller = CanaryController(cfg)
+            self._canary = CanaryState(unit=unit, controller=controller,
+                                       config=controller.config)
+            self._deploy.canary_fraction.set(
+                0.0 if cfg.shadow else controller.config.fraction)
+            self._set_release_status(release, "CANARY",
+                                     "shadow" if cfg.shadow else
+                                     f"fraction={controller.config.fraction}")
+            return web.json_response({
+                "message": "Canary started",
+                "engineInstanceId": instance.id,
+                "releaseVersion": unit.release_version or None,
+                "canary": controller.to_dict(),
+                "warmup": (self._last_warmup.to_dict()
+                           if self._last_warmup else None)})
+        self._swap_to(unit, mode=mode, reason="deploy")
+        return web.json_response({
+            "message": "Deployed",
+            "engineInstanceId": instance.id,
+            "releaseVersion": unit.release_version or None,
+            "warmup": (self._last_warmup.to_dict()
+                       if self._last_warmup else None)})
+
+    async def _settle_canary_first(self) -> Optional[web.Response]:
+        """Swap-initiating endpoints (deploy/reload) must not run over a
+        live canary: an undecided rollout is refused with 409 (a swap
+        would poison the judge's incumbent baseline), and a decided-but-
+        not-yet-acted verdict is acted on NOW so it can never be silently
+        overwritten (or resurface after an operator action)."""
+        canary = self._canary
+        if canary is None:
+            return None
+        if canary.controller.decided is None:
+            return web.json_response(
+                {"message": "A canary rollout is already in progress; "
+                            "rollback or wait for its verdict first."},
+                status=409)
+        await self._act_on_verdict(canary, canary.controller.decided)
+        return None
+
+    def _canary_config(self, body: dict) -> Optional[CanaryConfig]:
+        """A deploy body opts into a staged rollout with canaryFraction
+        or shadow; DeployConfig supplies every unspecified knob."""
+        if not (body.get("canaryFraction") or body.get("shadow")):
+            return None
+        dc = self.deploy_config
+        return CanaryConfig(
+            fraction=float(body.get("canaryFraction",
+                                    dc.canary_fraction) or 0.0),
+            shadow=bool(body.get("shadow", False)),
+            window=int(body.get("canaryWindow", dc.canary_window)),
+            min_samples=int(body.get("canaryMinSamples",
+                                     dc.canary_min_samples)),
+            promote_after=int(body.get("canaryPromoteAfter",
+                                       dc.canary_promote_after)),
+            p99_ratio=float(body.get("canaryP99Ratio", dc.canary_p99_ratio)),
+            latency_slack_s=float(body.get("canaryLatencySlackS",
+                                           dc.canary_latency_slack_s)),
+            error_rate_slack=float(body.get("canaryErrorRateSlack",
+                                            dc.canary_error_rate_slack)),
+        )
+
+    async def handle_rollback(self, request):
+        """Operator rollback: abort an active canary, else restore the
+        resident standby (previous LIVE release) — and as a last resort
+        re-load the previous release from the registry."""
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        canary = self._canary
+        if canary is not None:
+            if canary.controller.decided is None:
+                canary.controller.decided = ("rollback", "operator")
+                await self._act_on_verdict(canary, ("rollback", "operator"))
+                return web.json_response({
+                    "message": "Canary aborted",
+                    "engineInstanceId": canary.unit.instance.id})
+            # a verdict is queued but unacted: settle it before rolling
+            # back, or a pending promote task would silently re-install
+            # the release the operator just rolled away from
+            decision = canary.controller.decided
+            await self._act_on_verdict(canary, decision)
+            if decision[0] == "rollback":
+                # the SLO guard already did what the operator came to do;
+                # demoting the healthy incumbent too would punish a
+                # timing race, not a release
+                return web.json_response({
+                    "message": "Canary aborted",
+                    "engineInstanceId": canary.unit.instance.id})
+        target = self._standby
+        if target is None or target.result is None:
+            target = await self._load_previous_release()
+        if target is None:
+            return web.json_response(
+                {"message": "No previous release to roll back to."},
+                status=404)
+        rolled_back = self._unit
+        if target.batcher is None:
+            self._attach_batcher(target)
+        self._deploy.rollback_total.inc(reason="operator")
+        self._swap_to(target, mode="rollback", reason="operator rollback",
+                      retire_old=False)
+        self._set_release_status(rolled_back.release, "ROLLED_BACK",
+                                 "operator rollback")
+        self._standby = None      # never flip-flop back onto the bad one
+        return web.json_response({
+            "message": "Rolled back",
+            "engineInstanceId": target.instance.id,
+            "releaseVersion": target.release_version or None})
+
+    async def _load_previous_release(self) -> Optional[ServingUnit]:
+        """Registry-backed rollback target: the newest RETIRED release
+        below the active version (used when no standby is resident —
+        e.g. the server restarted since the last swap)."""
+        loop = asyncio.get_running_loop()
+
+        def _find():
+            try:
+                releases = Storage.get_meta_data_releases()
+                instances = Storage.get_meta_data_engine_instances()
+            except Exception:
+                return None, None
+            active_v = self._unit.release_version
+            for r in releases.get_for_variant(
+                    self.instance.engine_id, self.instance.engine_version,
+                    self.instance.engine_variant):
+                if active_v and r.version >= active_v:
+                    continue
+                if r.status not in ("RETIRED", "LIVE"):
+                    continue
+                inst = instances.get(r.instance_id)
+                if inst is not None and inst.status == "COMPLETED":
+                    return inst, r
+            return None, None
+
+        instance, release = await loop.run_in_executor(None, _find)
+        if instance is None:
+            return None
+        try:
+            return await self._prepare_unit(instance, release)
+        except DeployError:
+            logger.exception("previous release failed to prepare")
+            return None
+
+    async def handle_releases(self, request):
+        """Release manifests for this engine variant, newest first."""
+        loop = asyncio.get_running_loop()
+
+        def _list():
+            try:
+                releases = Storage.get_meta_data_releases()
+                return [release_to_json(r) for r in releases.get_for_variant(
+                    self.instance.engine_id, self.instance.engine_version,
+                    self.instance.engine_variant)]
+            except Exception:
+                logger.exception("release listing failed")
+                return []
+
+        listing = await loop.run_in_executor(None, _list)
+        return web.json_response({
+            "releases": listing,
+            "serving": {
+                "engineInstanceId": self.instance.id,
+                "releaseVersion": self._unit.release_version or None,
+            }})
+
+    async def handle_deploy_status(self, request):
+        canary = self._canary
+        return web.json_response({
+            "active": {
+                "engineInstanceId": self.instance.id,
+                "releaseVersion": self._unit.release_version or None,
+                "vectorized": self._unit.vectorized,
+            },
+            "standby": ({
+                "engineInstanceId": self._standby.instance.id,
+                "releaseVersion": self._standby.release_version or None,
+            } if self._standby is not None else None),
+            "canary": ({
+                "engineInstanceId": canary.unit.instance.id,
+                "releaseVersion": canary.unit.release_version or None,
+                **canary.controller.to_dict(),
+            } if canary is not None else None),
+            "lastWarmup": (self._last_warmup.to_dict()
+                           if self._last_warmup else None),
+        })
 
     async def handle_stop(self, request):
         if not self._authorized(request):
@@ -693,11 +1288,13 @@ def run_query_server(engine: Engine, train_result: TrainResult,
     from predictionio_tpu.utils.server_config import ServerConfig
 
     cfg = ServerConfig.load()
-    # server.conf key guards /stop and /reload when no explicit key given
-    # (CreateServer + KeyAuthentication.scala:33-62)
+    # server.conf key guards /stop, /reload and the deploy endpoints when
+    # no explicit key given (CreateServer + KeyAuthentication.scala:33-62)
     kwargs.setdefault("access_key", cfg.key or None)
     # micro-batch tuning from server.json "serving" + PIO_BATCH_* env
     kwargs.setdefault("serving_config", cfg.serving)
+    # warm-swap/canary tuning from server.json "deploy" + PIO_CANARY_* env
+    kwargs.setdefault("deploy_config", cfg.deploy)
     server = create_query_server(engine, train_result, instance, ctx, **kwargs)
     ssl_ctx = cfg.ssl_context()
     logger.info("Query server listening on %s:%s%s", ip, port,

@@ -21,6 +21,9 @@ from predictionio_tpu.storage.base import (
     EventStore,
     Model,
     Models,
+    RELEASE_STATUSES,
+    Release,
+    Releases,
     StorageError,
     UNFILTERED,
 )
@@ -29,6 +32,7 @@ from predictionio_tpu.storage.registry import Storage
 __all__ = [
     "App", "Apps", "AccessKey", "AccessKeys", "Channel", "Channels",
     "EngineInstance", "EngineInstances", "EvaluationInstance",
-    "EvaluationInstances", "Model", "Models", "EventStore", "StorageError",
+    "EvaluationInstances", "Model", "Models", "EventStore",
+    "Release", "Releases", "RELEASE_STATUSES", "StorageError",
     "UNFILTERED", "Storage",
 ]

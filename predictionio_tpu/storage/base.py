@@ -150,6 +150,42 @@ class Model:
     models: bytes
 
 
+#: the release lifecycle (deploy/ subsystem). A release is REGISTERED by
+#: run_train, becomes CANARY while a traffic split judges it, LIVE when
+#: serving full traffic, RETIRED when superseded by a newer LIVE release,
+#: and ROLLED_BACK when the SLO guard (or an operator) rejected it.
+RELEASE_STATUSES = ("REGISTERED", "CANARY", "LIVE", "RETIRED", "ROLLED_BACK")
+
+
+@dataclasses.dataclass
+class Release:
+    """One deployable version of an engine variant (deploy/ subsystem).
+
+    The EngineInstance row records *how a train ran*; the Release records
+    *what is shippable*: a monotonically increasing version per
+    (engine_id, engine_version, engine_variant), content digests of the
+    params and the serialized model blob (so "did anything actually
+    change?" is answerable without loading the blob), and a status whose
+    full lineage is kept in `history` as
+    ``[{"status": ..., "timeMs": ..., "reason": ...}, ...]``.
+    """
+
+    id: str = ""
+    version: int = 0                 # assigned by insert(): max+1 per variant
+    engine_id: str = ""
+    engine_version: str = ""
+    engine_variant: str = ""
+    instance_id: str = ""            # the COMPLETED EngineInstance behind it
+    params_digest: str = ""
+    model_digest: str = ""
+    model_size_bytes: int = 0
+    status: str = "REGISTERED"
+    created_time: _dt.datetime = dataclasses.field(default_factory=_utcnow)
+    train_seconds: float = 0.0
+    batch: str = ""
+    history: List[Dict] = dataclasses.field(default_factory=list)
+
+
 # ---------------------------------------------------------------------------
 # Metadata store interfaces
 # ---------------------------------------------------------------------------
@@ -276,6 +312,73 @@ class Models(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, model_id: str) -> None: ...
+
+
+class Releases(abc.ABC):
+    """Versioned release manifests (deploy/ subsystem; no reference
+    counterpart — the reference redeploys whatever instance is latest
+    with no way back)."""
+
+    @abc.abstractmethod
+    def insert(self, release: Release) -> str:
+        """Persist; assigns `id` (when empty) and the next `version` for
+        the release's (engine_id, engine_version, engine_variant).
+        Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, release_id: str) -> Optional[Release]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[Release]: ...
+
+    @abc.abstractmethod
+    def get_for_variant(self, engine_id: str, engine_version: str,
+                        engine_variant: str) -> List[Release]:
+        """All releases of one variant, newest version first."""
+
+    @abc.abstractmethod
+    def update(self, release: Release) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, release_id: str) -> None: ...
+
+    # -- lifecycle conveniences (shared across backends) ---------------------
+    def get_by_version(self, engine_id: str, engine_version: str,
+                       engine_variant: str, version: int
+                       ) -> Optional[Release]:
+        for r in self.get_for_variant(engine_id, engine_version,
+                                      engine_variant):
+            if r.version == version:
+                return r
+        return None
+
+    def latest(self, engine_id: str, engine_version: str,
+               engine_variant: str,
+               status: Optional[str] = None) -> Optional[Release]:
+        """Newest release of the variant, optionally filtered by status."""
+        for r in self.get_for_variant(engine_id, engine_version,
+                                      engine_variant):
+            if status is None or r.status == status:
+                return r
+        return None
+
+    def set_status(self, release_id: str, status: str,
+                   reason: str = "") -> Optional[Release]:
+        """Transition a release's status, appending to its history
+        lineage. Returns the updated release (None when unknown)."""
+        if status not in RELEASE_STATUSES:
+            raise ValueError(f"unknown release status {status!r}")
+        release = self.get(release_id)
+        if release is None:
+            return None
+        release.status = status
+        release.history = list(release.history) + [{
+            "status": status,
+            "timeMs": int(_utcnow().timestamp() * 1000),
+            "reason": reason,
+        }]
+        self.update(release)
+        return release
 
 
 # ---------------------------------------------------------------------------

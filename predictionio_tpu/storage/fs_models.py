@@ -9,6 +9,7 @@ path, ``hdfs://``, ``s3://``, ``memory://``. File-per-model, like all three.
 
 from __future__ import annotations
 
+import uuid
 from typing import Optional
 
 from predictionio_tpu.storage import base
@@ -29,8 +30,23 @@ class FSModels(base.Models):
         return f"{self.root}/pio_model_{model_id}.bin"
 
     def insert(self, model: Model) -> None:
-        with self.fs.open(self._path(model.id), "wb") as f:
-            f.write(model.models)
+        # write-then-rename: a concurrent get() during a deploy must see
+        # either the old blob or the new one, never a torn half-write.
+        # The temp name stays inside the store root (same fs, same dir)
+        # so the final mv is a metadata move, not a copy.
+        path = self._path(model.id)
+        tmp = f"{path}.tmp-{uuid.uuid4().hex}"
+        try:
+            with self.fs.open(tmp, "wb") as f:
+                f.write(model.models)
+            self.fs.mv(tmp, path)
+        except BaseException:
+            try:
+                if self.fs.exists(tmp):
+                    self.fs.rm(tmp)
+            except Exception:
+                pass
+            raise
 
     def get(self, model_id: str) -> Optional[Model]:
         path = self._path(model_id)

@@ -26,7 +26,7 @@ from predictionio_tpu.data.event import UTC, Event, millis as _to_ms
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.base import (
     AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
-    StorageError, UNFILTERED, generate_id,
+    Release, StorageError, UNFILTERED, generate_id,
 )
 from predictionio_tpu.storage.sqlite_backend import (
     _from_ms, _tz_offset_min, event_table_name,
@@ -558,6 +558,100 @@ def _row_to_ei(row) -> EngineInstance:
         env=json.loads(row[9] or "{}"), runtime_conf=json.loads(row[10] or "{}"),
         data_source_params=row[11], preparator_params=row[12],
         algorithms_params=row[13], serving_params=row[14])
+
+
+_REL_COLS = ("id, version, engineId, engineVersion, engineVariant, "
+             "instanceId, paramsDigest, modelDigest, modelSizeBytes, "
+             "status, createdTime, trainSeconds, batch, history")
+
+
+class PostgresReleases(_PgMetaBase, base.Releases):
+    """Release manifests (deploy/ subsystem) in PostgreSQL."""
+
+    def _ddl(self):
+        self.client.execute("""CREATE TABLE IF NOT EXISTS pio_releases (
+            id TEXT PRIMARY KEY, version INTEGER NOT NULL,
+            engineId TEXT, engineVersion TEXT, engineVariant TEXT,
+            instanceId TEXT, paramsDigest TEXT, modelDigest TEXT,
+            modelSizeBytes BIGINT, status TEXT, createdTime BIGINT,
+            trainSeconds DOUBLE PRECISION, batch TEXT, history TEXT)""")
+        # the MAX+1 subselect takes no lock under READ COMMITTED; this
+        # constraint is what makes concurrent same-variant trains collide
+        # instead of silently sharing a version (insert retries below)
+        self.client.execute(
+            "CREATE UNIQUE INDEX IF NOT EXISTS pio_releases_variant_version "
+            "ON pio_releases (engineId, engineVersion, engineVariant, "
+            "version)")
+
+    def insert(self, r: Release) -> str:
+        rid = r.id or generate_id()
+        r.id = rid
+        for _attempt in range(8):
+            try:
+                cur = self._exec(
+                    f"INSERT INTO pio_releases ({_REL_COLS}) VALUES "
+                    "((%s), (SELECT COALESCE(MAX(version), 0) + 1 "
+                    "FROM pio_releases WHERE engineId=%s AND "
+                    "engineVersion=%s AND engineVariant=%s),"
+                    "%s,%s,%s,%s,%s,%s,%s,%s,%s,%s,%s,%s) "
+                    "RETURNING version",
+                    (rid, r.engine_id, r.engine_version, r.engine_variant,
+                     r.engine_id, r.engine_version, r.engine_variant,
+                     r.instance_id, r.params_digest, r.model_digest,
+                     r.model_size_bytes, r.status, _to_ms(r.created_time),
+                     r.train_seconds, r.batch, json.dumps(r.history)))
+            except self.client.integrity_error:
+                # unique-index collision with a concurrent train
+                # (client.execute already rolled back); recompute MAX+1
+                continue
+            row = cur.fetchone()
+            if row:
+                r.version = int(row[0])
+            return rid
+        raise StorageError(
+            f"could not claim a release version for {r.engine_id}/"
+            f"{r.engine_variant} after 8 attempts")
+
+    def get(self, release_id: str) -> Optional[Release]:
+        row = self._query(
+            f"SELECT {_REL_COLS} FROM pio_releases WHERE id=%s",
+            (release_id,)).fetchone()
+        return _row_to_release(row) if row else None
+
+    def get_all(self) -> List[Release]:
+        return [_row_to_release(r) for r in self._query(
+            f"SELECT {_REL_COLS} FROM pio_releases "
+            "ORDER BY engineId, engineVariant, version DESC")]
+
+    def get_for_variant(self, engine_id, engine_version, engine_variant):
+        return [_row_to_release(r) for r in self._query(
+            f"SELECT {_REL_COLS} FROM pio_releases WHERE engineId=%s AND "
+            "engineVersion=%s AND engineVariant=%s ORDER BY version DESC",
+            (engine_id, engine_version, engine_variant))]
+
+    def update(self, r: Release) -> None:
+        self._exec(
+            "UPDATE pio_releases SET version=%s, engineId=%s, "
+            "engineVersion=%s, engineVariant=%s, instanceId=%s, "
+            "paramsDigest=%s, modelDigest=%s, modelSizeBytes=%s, status=%s, "
+            "createdTime=%s, trainSeconds=%s, batch=%s, history=%s "
+            "WHERE id=%s",
+            (r.version, r.engine_id, r.engine_version, r.engine_variant,
+             r.instance_id, r.params_digest, r.model_digest,
+             r.model_size_bytes, r.status, _to_ms(r.created_time),
+             r.train_seconds, r.batch, json.dumps(r.history), r.id))
+
+    def delete(self, release_id: str) -> None:
+        self._exec("DELETE FROM pio_releases WHERE id=%s", (release_id,))
+
+
+def _row_to_release(row) -> Release:
+    return Release(
+        id=row[0], version=row[1], engine_id=row[2], engine_version=row[3],
+        engine_variant=row[4], instance_id=row[5], params_digest=row[6],
+        model_digest=row[7], model_size_bytes=row[8], status=row[9],
+        created_time=_from_ms(row[10]), train_seconds=row[11],
+        batch=row[12], history=json.loads(row[13] or "[]"))
 
 
 _EVI_COLS = ("id, status, startTime, endTime, evaluationClass, "

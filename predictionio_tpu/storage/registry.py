@@ -221,6 +221,11 @@ class Storage:
         return cls._get("METADATA", "evaluationinstances")
 
     @classmethod
+    def get_meta_data_releases(cls) -> base.Releases:
+        """Versioned release manifests (deploy/ subsystem)."""
+        return cls._get("METADATA", "releases")
+
+    @classmethod
     def get_model_data_models(cls) -> base.Models:
         return cls._get("MODELDATA", "models")
 
@@ -237,6 +242,7 @@ class Storage:
         cls.get_meta_data_channels()
         cls.get_meta_data_engine_instances()
         cls.get_meta_data_evaluation_instances()
+        cls.get_meta_data_releases()
         cls.get_model_data_models()
         events = cls.get_events()
         events.init_channel(0, None)
@@ -253,6 +259,7 @@ def _construct(stype: str, kind: str, client, source_conf: Dict[str, str]):
             "channels": sb.SqliteChannels,
             "engineinstances": sb.SqliteEngineInstances,
             "evaluationinstances": sb.SqliteEvaluationInstances,
+            "releases": sb.SqliteReleases,
             "models": sb.SqliteModels,
             "events": sb.SqliteEvents,
         }
@@ -265,6 +272,7 @@ def _construct(stype: str, kind: str, client, source_conf: Dict[str, str]):
             "channels": pg.PostgresChannels,
             "engineinstances": pg.PostgresEngineInstances,
             "evaluationinstances": pg.PostgresEvaluationInstances,
+            "releases": pg.PostgresReleases,
             "models": pg.PostgresModels,
             "events": pg.PostgresEvents,
         }

@@ -24,7 +24,7 @@ from predictionio_tpu.data.event import UTC, Event, millis as _to_ms
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.base import (
     AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
-    StorageError, UNFILTERED, generate_id,
+    Release, StorageError, UNFILTERED, generate_id,
 )
 
 
@@ -650,6 +650,102 @@ def _row_to_evi(row) -> EvaluationInstance:
         env=json.loads(row[7] or "{}"), runtime_conf=json.loads(row[8] or "{}"),
         evaluator_results=row[9], evaluator_results_html=row[10],
         evaluator_results_json=row[11])
+
+
+_REL_COLS = ("id, version, engineId, engineVersion, engineVariant, "
+             "instanceId, paramsDigest, modelDigest, modelSizeBytes, "
+             "status, createdTime, trainSeconds, batch, history")
+
+
+class SqliteReleases(_MetaBase, base.Releases):
+    """Release manifests (deploy/ subsystem) in sqlite."""
+
+    def _ddl(self, conn):
+        conn.execute("""CREATE TABLE IF NOT EXISTS pio_releases (
+            id TEXT PRIMARY KEY, version INTEGER NOT NULL,
+            engineId TEXT, engineVersion TEXT, engineVariant TEXT,
+            instanceId TEXT, paramsDigest TEXT, modelDigest TEXT,
+            modelSizeBytes INTEGER, status TEXT, createdTime INTEGER,
+            trainSeconds REAL, batch TEXT, history TEXT)""")
+        # two trains of the same variant must never share a version —
+        # the constraint catches races the in-process write lock cannot
+        # (concurrent `pio train` PROCESSES on one sqlite file)
+        conn.execute(
+            "CREATE UNIQUE INDEX IF NOT EXISTS pio_releases_variant_version "
+            "ON pio_releases (engineId, engineVersion, engineVariant, "
+            "version)")
+
+    def insert(self, r: Release) -> str:
+        rid = r.id or generate_id()
+        r.id = rid
+        for _attempt in range(8):
+            with self.client.write_lock():
+                conn = self.client.conn()
+                row = conn.execute(
+                    "SELECT COALESCE(MAX(version), 0) FROM pio_releases "
+                    "WHERE engineId=? AND engineVersion=? AND "
+                    "engineVariant=?",
+                    (r.engine_id, r.engine_version,
+                     r.engine_variant)).fetchone()
+                r.version = int(row[0]) + 1
+                try:
+                    conn.execute(
+                        f"INSERT INTO pio_releases ({_REL_COLS}) "
+                        "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                        (rid, r.version, r.engine_id, r.engine_version,
+                         r.engine_variant, r.instance_id, r.params_digest,
+                         r.model_digest, r.model_size_bytes, r.status,
+                         _to_ms(r.created_time), r.train_seconds, r.batch,
+                         json.dumps(r.history)))
+                    conn.commit()
+                    return rid
+                except sqlite3.IntegrityError:
+                    # another PROCESS claimed this version between the
+                    # MAX read and the insert; re-read and retry
+                    conn.rollback()
+        raise StorageError(
+            f"could not claim a release version for {r.engine_id}/"
+            f"{r.engine_variant} after 8 attempts")
+
+    def get(self, release_id: str) -> Optional[Release]:
+        row = self._query(
+            f"SELECT {_REL_COLS} FROM pio_releases WHERE id=?",
+            (release_id,)).fetchone()
+        return _row_to_release(row) if row else None
+
+    def get_all(self) -> List[Release]:
+        return [_row_to_release(r) for r in self._query(
+            f"SELECT {_REL_COLS} FROM pio_releases "
+            "ORDER BY engineId, engineVariant, version DESC")]
+
+    def get_for_variant(self, engine_id, engine_version, engine_variant):
+        return [_row_to_release(r) for r in self._query(
+            f"SELECT {_REL_COLS} FROM pio_releases WHERE engineId=? AND "
+            "engineVersion=? AND engineVariant=? ORDER BY version DESC",
+            (engine_id, engine_version, engine_variant))]
+
+    def update(self, r: Release) -> None:
+        self._exec(
+            "UPDATE pio_releases SET version=?, engineId=?, engineVersion=?, "
+            "engineVariant=?, instanceId=?, paramsDigest=?, modelDigest=?, "
+            "modelSizeBytes=?, status=?, createdTime=?, trainSeconds=?, "
+            "batch=?, history=? WHERE id=?",
+            (r.version, r.engine_id, r.engine_version, r.engine_variant,
+             r.instance_id, r.params_digest, r.model_digest,
+             r.model_size_bytes, r.status, _to_ms(r.created_time),
+             r.train_seconds, r.batch, json.dumps(r.history), r.id))
+
+    def delete(self, release_id: str) -> None:
+        self._exec("DELETE FROM pio_releases WHERE id=?", (release_id,))
+
+
+def _row_to_release(row) -> Release:
+    return Release(
+        id=row[0], version=row[1], engine_id=row[2], engine_version=row[3],
+        engine_variant=row[4], instance_id=row[5], params_digest=row[6],
+        model_digest=row[7], model_size_bytes=row[8], status=row[9],
+        created_time=_from_ms(row[10]), train_seconds=row[11],
+        batch=row[12], history=json.loads(row[13] or "[]"))
 
 
 class SqliteModels(_MetaBase, base.Models):

@@ -14,12 +14,15 @@ Config file: ``$PIO_CONF_DIR/server.json`` (or the path in
 
     {"key": "<accessKey or empty>",
      "ssl": {"enabled": false, "certfile": "...", "keyfile": "..."},
-     "serving": {"batchMax": 64, "batchLingerS": null, "batchInflight": 2}}
+     "serving": {"batchMax": 64, "batchLingerS": null, "batchInflight": 2},
+     "deploy": {"warmup": true, "canaryFraction": 0.1, "canaryWindow": 200,
+                "canaryPromoteAfter": 100, "canaryP99Ratio": 2.0}}
 
 All fields optional; env vars ``PIO_SERVER_KEY`` / ``PIO_SSL_CERTFILE`` /
 ``PIO_SSL_KEYFILE`` override file values, as do the serving-tuning knobs
 ``PIO_BATCH_MAX`` / ``PIO_BATCH_LINGER_S`` / ``PIO_BATCH_INFLIGHT``
-(README "Serving tuning").
+(README "Serving tuning") and the deploy-lifecycle knobs
+``PIO_DEPLOY_WARMUP`` / ``PIO_CANARY_*`` (README "Deploy lifecycle").
 """
 
 from __future__ import annotations
@@ -88,12 +91,95 @@ class ServingConfig:
 
 
 @dataclasses.dataclass
+class DeployConfig:
+    """Deploy-lifecycle tuning (the ``PIO_DEPLOY_*`` / ``PIO_CANARY_*``
+    knobs; server.json ``deploy`` section, camelCase keys).
+
+    ``warmup=False`` turns /reload and /deploy into cold swaps (the
+    pre-PR behavior) — useful only for measuring what warmup buys.
+    The canary_* fields are the DEFAULTS for a staged rollout; a
+    POST /deploy.json body can override any of them per deployment.
+    """
+
+    warmup: bool = True              # pre-compile the bucket ladder
+    drain_timeout_s: float = 5.0     # grace for the retired unit's batches
+    canary_fraction: float = 0.1
+    canary_window: int = 200
+    canary_min_samples: int = 20
+    canary_promote_after: int = 100
+    canary_p99_ratio: float = 2.0
+    canary_latency_slack_s: float = 0.025
+    canary_error_rate_slack: float = 0.05
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None) -> "DeployConfig":
+        """server.json ``deploy`` section overlaid by env vars (env
+        wins); malformed knobs are logged and fall back, same contract
+        as ServingConfig."""
+        data = data or {}
+        cfg = cls()
+        as_bool = lambda v: str(v).strip().lower() not in (  # noqa: E731
+            "0", "false", "no", "off", "")
+        sources = (
+            ("warmup", data.get("warmup"), "warmup", as_bool),
+            ("drainTimeoutS", data.get("drainTimeoutS"),
+             "drain_timeout_s", float),
+            ("canaryFraction", data.get("canaryFraction"),
+             "canary_fraction", float),
+            ("canaryWindow", data.get("canaryWindow"), "canary_window", int),
+            ("canaryMinSamples", data.get("canaryMinSamples"),
+             "canary_min_samples", int),
+            ("canaryPromoteAfter", data.get("canaryPromoteAfter"),
+             "canary_promote_after", int),
+            ("canaryP99Ratio", data.get("canaryP99Ratio"),
+             "canary_p99_ratio", float),
+            ("canaryLatencySlackS", data.get("canaryLatencySlackS"),
+             "canary_latency_slack_s", float),
+            ("canaryErrorRateSlack", data.get("canaryErrorRateSlack"),
+             "canary_error_rate_slack", float),
+            ("PIO_DEPLOY_WARMUP", os.environ.get("PIO_DEPLOY_WARMUP"),
+             "warmup", as_bool),
+            ("PIO_DEPLOY_DRAIN_TIMEOUT_S",
+             os.environ.get("PIO_DEPLOY_DRAIN_TIMEOUT_S"),
+             "drain_timeout_s", float),
+            ("PIO_CANARY_FRACTION", os.environ.get("PIO_CANARY_FRACTION"),
+             "canary_fraction", float),
+            ("PIO_CANARY_WINDOW", os.environ.get("PIO_CANARY_WINDOW"),
+             "canary_window", int),
+            ("PIO_CANARY_MIN_SAMPLES",
+             os.environ.get("PIO_CANARY_MIN_SAMPLES"),
+             "canary_min_samples", int),
+            ("PIO_CANARY_PROMOTE_AFTER",
+             os.environ.get("PIO_CANARY_PROMOTE_AFTER"),
+             "canary_promote_after", int),
+            ("PIO_CANARY_P99_RATIO", os.environ.get("PIO_CANARY_P99_RATIO"),
+             "canary_p99_ratio", float),
+            ("PIO_CANARY_LATENCY_SLACK_S",
+             os.environ.get("PIO_CANARY_LATENCY_SLACK_S"),
+             "canary_latency_slack_s", float),
+            ("PIO_CANARY_ERROR_SLACK",
+             os.environ.get("PIO_CANARY_ERROR_SLACK"),
+             "canary_error_rate_slack", float),
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed deploy knob %s=%r",
+                               name, raw)
+        return cfg
+
+
+@dataclasses.dataclass
 class ServerConfig:
     key: str = ""
     ssl_enabled: bool = False
     certfile: Optional[str] = None
     keyfile: Optional[str] = None
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    deploy: DeployConfig = dataclasses.field(default_factory=DeployConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "ServerConfig":
@@ -117,6 +203,7 @@ class ServerConfig:
             certfile=ssl_conf.get("certfile"),
             keyfile=ssl_conf.get("keyfile"),
             serving=ServingConfig.from_env(data.get("serving") or {}),
+            deploy=DeployConfig.from_env(data.get("deploy") or {}),
         )
         if os.environ.get("PIO_SERVER_KEY"):
             cfg.key = os.environ["PIO_SERVER_KEY"]

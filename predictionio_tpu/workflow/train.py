@@ -63,6 +63,7 @@ def run_train(engine: Engine,
     # on the backend mutating the record in place
     logger.info("EngineInstance %s created (INIT)", instance_id)
 
+    blob = None
     with workflow_run_metrics("train", "pio_train"):
         # CoreWorkflow.runTrain:45 — train, persist, mark COMPLETED
         result = engine.train(
@@ -82,6 +83,17 @@ def run_train(engine: Engine,
         instance.status = "COMPLETED"
         instance.end_time = _dt.datetime.now(tz=UTC)
         instances.update(instance)
+
+    # register the completed instance as the variant's next release
+    # (deploy/ subsystem: `pio releases` listing, warm deploys, rollback
+    # lineage). Best-effort by contract — the train already succeeded.
+    from predictionio_tpu.deploy.releases import record_release
+
+    record_release(
+        instance,
+        train_seconds=(instance.end_time - instance.start_time
+                       ).total_seconds(),
+        blob=blob)
     if getattr(ctx, "checkpointer", None) is not None:
         # resume is for crashed/preempted runs only: a completed run clears
         # its snapshots so the next train never resumes from stale factors

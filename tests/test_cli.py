@@ -86,6 +86,13 @@ def test_cli_full_lifecycle(clienv, tmp_path, monkeypatch):
     out = _ok(r.invoke(cli, ["train"]))
     assert "Training completed" in out
 
+    # the train registered release v1 (deploy/ registry surface)
+    out = _ok(r.invoke(cli, ["releases"]))
+    assert "v1" in out and "REGISTERED" in out
+    assert "Finished listing 1 release(s)" in out
+    out = _ok(r.invoke(cli, ["releases", "--status", "rolled_back"]))
+    assert "Finished listing 0 release(s)" in out
+
     # batch scoring (BatchPredict.scala:71 analog)
     queries = tmp_path / "queries.json"
     queries.write_text("\n".join(

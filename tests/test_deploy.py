@@ -1,0 +1,932 @@
+"""Deploy lifecycle: release registry, warm swap, canary, rollback.
+
+Covers the deploy/ subsystem contracts end-to-end without training:
+models are built from random factors (the test_query_batcher recipe) and
+persisted through the real Models store, so the /deploy.json path runs
+load -> warmup -> verify -> swap against real storage in milliseconds.
+
+The two acceptance paths the ISSUE names are here:
+  * a canary deploy with an injected latency (and, separately, error)
+    regression auto-rolls back to the incumbent; a healthy canary
+    auto-promotes — both visible in pio_deploy_* metrics;
+  * a warm swap serves post-cutover traffic with ZERO new XLA compiles
+    for bucketed shapes (compile-counter delta across the swap is 0),
+    while a cold swap of a new catalog demonstrably compiles.
+"""
+
+import asyncio
+import functools
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+import predictionio_tpu.models.als as als_mod
+from predictionio_tpu.core.base import Algorithm, Serving
+from predictionio_tpu.core.engine import Engine, TrainResult
+from predictionio_tpu.core.params import EngineParams
+from predictionio_tpu.deploy.canary import (
+    CanaryConfig, CanaryController, SlidingStats, TrafficSplitter,
+)
+from predictionio_tpu.deploy.releases import (
+    model_digest, params_digest, record_release, resolve_release,
+)
+from predictionio_tpu.deploy.warm import (
+    ServingUnit, warmup_ladder, warmup_unit,
+)
+from predictionio_tpu.engines.recommendation import (
+    ALSAlgorithm, AlgorithmParams, RecommendationServing,
+)
+from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.obs.jax_stats import compile_counter
+from predictionio_tpu.obs.registry import default_registry
+from predictionio_tpu.server.query_server import QueryServer
+from predictionio_tpu.storage import Model, Release, Storage
+from predictionio_tpu.storage.base import EngineInstance
+from predictionio_tpu.utils.server_config import DeployConfig, ServingConfig
+from predictionio_tpu.workflow.serialization import serialize_models
+
+pytestmark = pytest.mark.anyio
+
+N_USERS, RANK = 40, 6
+ENGINE_ID, VARIANT = "deploy-test-engine", "default"
+
+
+def make_als_model(seed=0, n_items=30) -> ALSModel:
+    rng = np.random.default_rng(seed)
+    return ALSModel(
+        user_vocab=np.sort(np.asarray(
+            [f"u{i}" for i in range(N_USERS)], dtype=object)),
+        item_vocab=np.sort(np.asarray(
+            [f"i{i}" for i in range(n_items)], dtype=object)),
+        U=rng.normal(size=(N_USERS, RANK)).astype(np.float32),
+        V=rng.normal(size=(n_items, RANK)).astype(np.float32))
+
+
+def make_engine(algo_cls=ALSAlgorithm) -> Engine:
+    """A recommendation-shaped engine whose deploy path instantiates
+    `algo_cls` — candidate releases prepared through /deploy.json score
+    with it (the regression-injection seam)."""
+    import predictionio_tpu.engines.recommendation as rec
+
+    return Engine(
+        data_source_classes=rec.RecommendationDataSource,
+        preparator_classes=rec.RecommendationPreparator,
+        algorithm_classes={"als": algo_cls},
+        serving_classes=RecommendationServing,
+    )
+
+
+def make_server(model=None, engine=None, instance=None, release=None,
+                deploy_config=None, serving_config=None) -> QueryServer:
+    model = model if model is not None else make_als_model()
+    result = TrainResult(models=[model],
+                         algorithms=[ALSAlgorithm(AlgorithmParams())],
+                         serving=RecommendationServing(),
+                         engine_params=EngineParams())
+    instance = instance or EngineInstance(
+        id="deploy-incumbent", engine_id=ENGINE_ID, engine_version="1",
+        engine_variant=VARIANT, status="COMPLETED")
+    return QueryServer(
+        engine or make_engine(), result, instance, ctx=None,
+        serving_config=serving_config or ServingConfig(
+            batch_max=16, batch_linger_s=0.0, batch_inflight=2),
+        deploy_config=deploy_config or DeployConfig(
+            warmup=True, drain_timeout_s=10.0),
+        release=release)
+
+
+@pytest.fixture()
+def deploy_store(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite",
+                           "PATH": str(tmp_path / "deploy.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    yield Storage
+    Storage.reset()
+
+
+def register_candidate(seed=1, n_items=30, instance_id="deploy-candidate"):
+    """Persist a factors-only model as a COMPLETED instance + release."""
+    instance = EngineInstance(
+        id=instance_id, status="COMPLETED", engine_id=ENGINE_ID,
+        engine_version="1", engine_variant=VARIANT,
+        data_source_params='{"app_name": "DeployApp"}',
+        algorithms_params='[{"name": "als", "params": {}}]')
+    Storage.get_meta_data_engine_instances().insert(instance)
+    blob = serialize_models([make_als_model(seed=seed, n_items=n_items)])
+    Storage.get_model_data_models().insert(Model(id=instance.id, models=blob))
+    return record_release(instance, train_seconds=1.0, blob=blob)
+
+
+# ---------------------------------------------------------------------------
+# release registry units
+# ---------------------------------------------------------------------------
+
+def test_release_versions_monotonic_per_variant(deploy_store):
+    rels = Storage.get_meta_data_releases()
+    a1 = Release(engine_id="a", engine_version="1", engine_variant="x")
+    a2 = Release(engine_id="a", engine_version="1", engine_variant="x")
+    b1 = Release(engine_id="b", engine_version="1", engine_variant="x")
+    for r in (a1, a2, b1):
+        rels.insert(r)
+    assert (a1.version, a2.version, b1.version) == (1, 2, 1)
+    listing = rels.get_for_variant("a", "1", "x")
+    assert [r.version for r in listing] == [2, 1]       # newest first
+
+
+def test_release_status_lineage(deploy_store):
+    rels = Storage.get_meta_data_releases()
+    r = Release(engine_id="a", engine_version="1", engine_variant="x")
+    rels.insert(r)
+    rels.set_status(r.id, "CANARY", reason="fraction=0.1")
+    rels.set_status(r.id, "ROLLED_BACK", reason="slo_latency: p99")
+    got = rels.get(r.id)
+    assert got.status == "ROLLED_BACK"
+    assert [h["status"] for h in got.history] == ["CANARY", "ROLLED_BACK"]
+    assert got.history[-1]["reason"].startswith("slo_latency")
+    with pytest.raises(ValueError):
+        rels.set_status(r.id, "NONSENSE")
+
+
+def test_resolve_release_selectors(deploy_store):
+    rels = Storage.get_meta_data_releases()
+    r1 = Release(engine_id="a", engine_version="1", engine_variant="x")
+    r2 = Release(engine_id="a", engine_version="1", engine_variant="x")
+    rels.insert(r1)
+    rels.insert(r2)
+    assert resolve_release(rels, "a", "1", "x", None).id == r2.id
+    assert resolve_release(rels, "a", "1", "x", r1.id).id == r1.id
+    assert resolve_release(rels, "a", "1", "x", "1").id == r1.id
+    assert resolve_release(rels, "a", "1", "x", "v2").id == r2.id
+    assert resolve_release(rels, "a", "1", "x", "v99") is None
+    assert resolve_release(rels, "a", "1", "x", "junk") is None
+    # a raw id from ANOTHER variant never resolves onto this one
+    foreign = Release(engine_id="b", engine_version="1", engine_variant="y")
+    rels.insert(foreign)
+    assert resolve_release(rels, "a", "1", "x", foreign.id) is None
+    assert resolve_release(rels, "b", "1", "y", foreign.id).id == foreign.id
+    # a rejected release never rides back in as "the latest" — only an
+    # explicit selector can redeploy it
+    rels.set_status(r2.id, "ROLLED_BACK", reason="slo breach")
+    assert resolve_release(rels, "a", "1", "x", None).id == r1.id
+    assert resolve_release(rels, "a", "1", "x", "v2").id == r2.id
+    rels.set_status(r1.id, "ROLLED_BACK", reason="slo breach")
+    assert resolve_release(rels, "a", "1", "x", None) is None
+
+
+def test_canary_config_clamps_fraction():
+    # a canary is judged against the incumbent, so the incumbent must
+    # keep traffic: fraction 1.0 would starve the baseline and wedge the
+    # rollout with no verdict ever reachable
+    cfg = CanaryConfig(fraction=1.0).normalized()
+    assert cfg.fraction == CanaryConfig.MAX_FRACTION
+    assert CanaryConfig(fraction=-3).normalized().fraction == 0.0
+
+
+def test_run_train_registers_release(deploy_store):
+    from fake_engine import Algo0, AlgoParams, DataSource0, Preparator0, \
+        Serving0
+    from predictionio_tpu.workflow import run_train
+
+    eng = Engine(DataSource0, Preparator0, {"a": Algo0}, Serving0)
+    ep = EngineParams(algorithm_params_list=[("a", AlgoParams(id=3))])
+    instance = run_train(eng, ep, engine_factory="tests.fake:engine",
+                         engine_variant="v1")
+    rels = Storage.get_meta_data_releases().get_for_variant(
+        "tests.fake:engine", "1", "v1")
+    assert len(rels) == 1
+    r = rels[0]
+    assert r.version == 1 and r.status == "REGISTERED"
+    assert r.instance_id == instance.id
+    assert r.params_digest == params_digest(instance)
+    blob = Storage.get_model_data_models().get(instance.id).models
+    assert r.model_digest == model_digest(blob)
+    assert r.model_size_bytes == len(blob)
+    # a retrain becomes v2 of the same variant
+    run_train(eng, ep, engine_factory="tests.fake:engine",
+              engine_variant="v1")
+    assert [x.version for x in Storage.get_meta_data_releases()
+            .get_for_variant("tests.fake:engine", "1", "v1")] == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# canary controller units
+# ---------------------------------------------------------------------------
+
+def test_traffic_splitter_exact_fraction():
+    s = TrafficSplitter(0.25)
+    routed = [s.route() for _ in range(100)]
+    assert sum(routed) == 25
+    assert TrafficSplitter(0.0).route() is False
+    assert all(TrafficSplitter(1.0).route() for _ in range(5))
+
+
+def test_sliding_stats_window_and_quantiles():
+    st = SlidingStats(window=4)
+    for v in (0.010, 0.020, 0.030, 0.040, 0.050):
+        st.observe(v, ok=True)
+    assert st.count() == 4                      # 0.010 aged out
+    assert st.quantile(0.5) == 0.030
+    assert st.p99() == 0.050
+    st.observe(0.0, ok=False)
+    assert st.error_rate() == pytest.approx(0.25)
+    assert st.total == 6
+
+
+def test_controller_rolls_back_on_latency_breach():
+    ctl = CanaryController(CanaryConfig(
+        fraction=0.5, min_samples=5, promote_after=50,
+        p99_ratio=1.5, latency_slack_s=0.001))
+    verdict = None
+    for _ in range(20):
+        ctl.observe("incumbent", 0.010, True)
+        verdict = verdict or ctl.observe("canary", 0.100, True)
+    assert verdict is not None and verdict[0] == "rollback"
+    assert verdict[1].startswith("slo_latency")
+    # controller is inert after deciding
+    assert ctl.observe("canary", 5.0, True) is None
+
+
+def test_controller_rolls_back_on_error_breach():
+    ctl = CanaryController(CanaryConfig(
+        fraction=0.5, min_samples=5, promote_after=50,
+        error_rate_slack=0.1))
+    verdict = None
+    for _ in range(10):
+        ctl.observe("incumbent", 0.010, True)
+        verdict = verdict or ctl.observe("canary", 0.010, False)
+    assert verdict is not None and verdict[0] == "rollback"
+    assert verdict[1].startswith("slo_errors")
+
+
+def test_controller_promotes_clean_window():
+    ctl = CanaryController(CanaryConfig(
+        fraction=0.5, min_samples=5, promote_after=12,
+        p99_ratio=3.0, latency_slack_s=0.5))
+    verdict = None
+    for _ in range(15):
+        ctl.observe("incumbent", 0.010, True)
+        verdict = verdict or ctl.observe("canary", 0.012, True)
+    assert verdict == ("promote", "healthy: SLO window clean")
+
+
+# ---------------------------------------------------------------------------
+# warm swap: the compile-delta acceptance check
+# ---------------------------------------------------------------------------
+
+def _total_compiles() -> float:
+    return sum(v for _l, v in compile_counter(default_registry()).samples())
+
+
+async def test_warm_swap_zero_new_compiles_post_cutover():
+    """The acceptance criterion: after a warm swap, the first post-
+    cutover batches hit only shapes the warmup ladder already compiled —
+    the pio_jax_compile_total delta across the swap is zero."""
+    from predictionio_tpu.engines.recommendation import Query
+
+    old_rt = als_mod._DEVICE_ROUNDTRIP_S
+    als_mod._DEVICE_ROUNDTRIP_S = 0.0       # force the jitted device scorer
+    try:
+        server = make_server()              # incumbent: 30-item catalog
+        # candidate: a NEW catalog size => its shape keys cannot ride the
+        # incumbent's compiled executables
+        unit_b = ServingUnit(
+            instance=EngineInstance(id="warm-candidate", engine_id=ENGINE_ID,
+                                    engine_version="1",
+                                    engine_variant=VARIANT),
+            result=TrainResult(models=[make_als_model(seed=5, n_items=41)],
+                               algorithms=[ALSAlgorithm(AlgorithmParams())],
+                               serving=RecommendationServing(),
+                               engine_params=EngineParams()),
+            ctx=None, vectorized=True)
+        server._attach_batcher(unit_b)
+        predict_batch = functools.partial(server._predict_batch_unit, unit_b)
+        report = warmup_unit(unit_b, predict_batch,
+                             server.serving_config.batch_max,
+                             query=Query(user="u0", num=4))
+        assert report.buckets == warmup_ladder(16) == [1, 2, 4, 8, 16]
+        assert report.compile_delta > 0      # warmup paid the compiles
+        assert report.skipped is None
+
+        c = TestClient(TestServer(server.app))
+        await c.start_server()
+        try:
+            before = _total_compiles()
+            server._swap_to(unit_b, mode="warm", reason="test")
+            for burst in (3, 5, 11):         # buckets 4, 8, 16
+                out = await asyncio.gather(*[
+                    c.post("/queries.json",
+                           json={"user": f"u{i % N_USERS}", "num": 4})
+                    for i in range(burst)])
+                for resp in out:
+                    assert resp.status == 200
+                    scores = (await resp.json())["itemScores"]
+                    assert len(scores) == 4
+                    # post-cutover traffic scores on the NEW catalog
+                    assert all(s["item"] in
+                               {f"i{j}" for j in range(41)}
+                               for s in scores)
+            assert _total_compiles() == before, \
+                "warm swap must pay zero post-cutover compiles"
+
+            # contrast: a COLD swap of yet another catalog compiles on
+            # the serving path
+            unit_c = ServingUnit(
+                instance=EngineInstance(id="cold", engine_id=ENGINE_ID,
+                                        engine_version="1",
+                                        engine_variant=VARIANT),
+                result=TrainResult(
+                    models=[make_als_model(seed=6, n_items=43)],
+                    algorithms=[ALSAlgorithm(AlgorithmParams())],
+                    serving=RecommendationServing(),
+                    engine_params=EngineParams()),
+                ctx=None, vectorized=True)
+            server._attach_batcher(unit_c)
+            before_cold = _total_compiles()
+            server._swap_to(unit_c, mode="cold", reason="test")
+            resp = await c.post("/queries.json", json={"user": "u1",
+                                                       "num": 4})
+            assert resp.status == 200
+            assert _total_compiles() > before_cold, \
+                "cold swap should have compiled on the serving path"
+        finally:
+            await c.close()
+    finally:
+        als_mod._DEVICE_ROUNDTRIP_S = old_rt
+
+
+# ---------------------------------------------------------------------------
+# /reload-vs-inflight-batch races (satellite): no half-swapped pairs
+# ---------------------------------------------------------------------------
+
+class PlainServing(Serving):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class BlockingTagAlgo(Algorithm):
+    """Vectorized algorithm whose batches block on an Event, then tag
+    results with its model — the probe for swap-while-draining."""
+
+    def __init__(self, gate: threading.Event):
+        self.gate = gate
+
+    def train(self, ctx, pd):
+        return None
+
+    def predict(self, model, query):
+        return {"model": model}
+
+    def batch_predict(self, model, queries):
+        assert self.gate.wait(timeout=10), "test gate never opened"
+        return [(i, {"model": model}) for i, _ in queries]
+
+
+class TagAlgoNotVectorized(Algorithm):
+    def train(self, ctx, pd):
+        return None
+
+    def predict(self, model, query):
+        return {"model": model}
+
+
+async def test_swap_while_batches_drain_no_half_swapped_pair():
+    """Swap a release while batches are draining: every in-flight request
+    must resolve on the unit it was routed to — model and vectorized
+    flag as ONE consistent pair, never mixed, never errored."""
+    gate = threading.Event()
+    result_a = TrainResult(models=["A"],
+                           algorithms=[BlockingTagAlgo(gate)],
+                           serving=PlainServing(),
+                           engine_params=EngineParams())
+    instance = EngineInstance(id="race-a", engine_id=ENGINE_ID,
+                              engine_version="1", engine_variant=VARIANT)
+    server = QueryServer(
+        make_engine(), result_a, instance, ctx=None,
+        serving_config=ServingConfig(batch_max=8, batch_linger_s=0.0,
+                                     batch_inflight=1),
+        deploy_config=DeployConfig(warmup=False, drain_timeout_s=10.0))
+    assert server._unit.vectorized is True
+
+    unit_b = ServingUnit(
+        instance=EngineInstance(id="race-b", engine_id=ENGINE_ID,
+                                engine_version="1", engine_variant=VARIANT),
+        result=TrainResult(models=["B"],
+                           algorithms=[TagAlgoNotVectorized()],
+                           serving=PlainServing(),
+                           engine_params=EngineParams()),
+        ctx=None, vectorized=False)
+    server._attach_batcher(unit_b)
+
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        old_unit = server._unit
+        first = [asyncio.ensure_future(c.post("/queries.json", json={"q": i}))
+                 for i in range(6)]
+        # let the batcher pick up + dispatch (blocked on the gate)
+        for _ in range(20):
+            await asyncio.sleep(0.01)
+            if old_unit.batcher._inflight_now > 0:
+                break
+        assert old_unit.batcher._inflight_now > 0
+
+        server._swap_to(unit_b, mode="warm", reason="race-test")
+        assert server._unit is unit_b
+
+        second = [asyncio.ensure_future(
+            c.post("/queries.json", json={"q": 100 + i})) for i in range(6)]
+        await asyncio.sleep(0.05)
+        gate.set()                       # old batches drain AFTER the swap
+
+        outs = []
+        for fut in first + second:
+            resp = await fut
+            assert resp.status == 200, await resp.text()
+            outs.append((await resp.json())["model"])
+        # pre-swap requests all scored on A (the unit they were routed
+        # to), post-swap on B — no mixes, no errors
+        assert outs[:6] == ["A"] * 6
+        assert outs[6:] == ["B"] * 6
+
+        # the retired unit's batcher drains and is torn down
+        for _ in range(100):
+            if old_unit.batcher is None:
+                break
+            await asyncio.sleep(0.02)
+        assert old_unit.batcher is None
+    finally:
+        await c.close()
+
+
+async def test_rollback_during_drain_window_keeps_live_batcher():
+    """Rolling back while the outgoing unit's batcher is still draining
+    must NOT let the pending retire task tear down the batcher that is
+    now serving live traffic again."""
+    gate = threading.Event()
+    result_a = TrainResult(models=["A"],
+                           algorithms=[BlockingTagAlgo(gate)],
+                           serving=PlainServing(),
+                           engine_params=EngineParams())
+    server = QueryServer(
+        make_engine(), result_a,
+        EngineInstance(id="drain-a", engine_id=ENGINE_ID,
+                       engine_version="1", engine_variant=VARIANT),
+        ctx=None,
+        serving_config=ServingConfig(batch_max=8, batch_linger_s=0.0,
+                                     batch_inflight=1),
+        deploy_config=DeployConfig(warmup=False, drain_timeout_s=0.3))
+    unit_a = server._unit
+    unit_b = ServingUnit(
+        instance=EngineInstance(id="drain-b", engine_id=ENGINE_ID,
+                                engine_version="1", engine_variant=VARIANT),
+        result=TrainResult(models=["B"],
+                           algorithms=[TagAlgoNotVectorized()],
+                           serving=PlainServing(),
+                           engine_params=EngineParams()),
+        ctx=None, vectorized=False)
+    server._attach_batcher(unit_b)
+
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        # block a batch on A, swap to B (A starts draining), then roll
+        # back to A BEFORE the 0.3s drain deadline fires
+        blocked = asyncio.ensure_future(
+            c.post("/queries.json", json={"q": 0}))
+        for _ in range(50):
+            await asyncio.sleep(0.01)
+            if unit_a.batcher._inflight_now > 0:
+                break
+        server._swap_to(unit_b, mode="cold", reason="drain-test")
+        resp = await c.post("/rollback.json")
+        assert resp.status == 200, await resp.json()
+        assert server._unit is unit_a
+        batcher = unit_a.batcher
+        assert batcher is not None
+        # outlive the original drain deadline, then prove A still serves
+        await asyncio.sleep(0.5)
+        gate.set()
+        assert (await (await blocked).json())["model"] == "A"
+        assert unit_a.batcher is batcher      # never torn down
+        resp = await c.post("/queries.json", json={"q": 1})
+        assert resp.status == 200
+        assert (await resp.json())["model"] == "A"
+    finally:
+        await c.close()
+
+
+# ---------------------------------------------------------------------------
+# the integration paths: canary rollback / promote / shadow / CLI rollback
+# ---------------------------------------------------------------------------
+
+class SlowALS(ALSAlgorithm):
+    """The injected latency regression: every batch pays +60ms."""
+
+    def batch_predict(self, model, queries):
+        time.sleep(0.06)
+        return super().batch_predict(model, queries)
+
+
+class ErrorALS(ALSAlgorithm):
+    """The injected error regression: scoring always fails."""
+
+    def predict(self, model, query):
+        raise RuntimeError("regressed model")
+
+    def batch_predict(self, model, queries):
+        raise RuntimeError("regressed model")
+
+
+async def _wait_release_status(release_id, status, timeout=3.0):
+    """Release lineage writes are scheduled off the serving path; poll
+    the store instead of racing them."""
+    deadline = time.monotonic() + timeout
+    rels = Storage.get_meta_data_releases()
+    while time.monotonic() < deadline:
+        got = rels.get(release_id)
+        if got is not None and got.status == status:
+            return got
+        await asyncio.sleep(0.02)
+    got = rels.get(release_id)
+    raise AssertionError(
+        f"release {release_id} never reached {status}; "
+        f"stuck at {got.status if got else None}")
+
+
+async def _drive(c, n, start=0):
+    statuses = []
+    for i in range(n):
+        resp = await c.post("/queries.json",
+                            json={"user": f"u{(start + i) % N_USERS}",
+                                  "num": 3})
+        await resp.json()
+        statuses.append(resp.status)
+    return statuses
+
+
+async def test_canary_latency_regression_auto_rolls_back(deploy_store):
+    release = register_candidate(seed=2)
+    server = make_server(engine=make_engine(SlowALS))
+    incumbent_id = server.instance.id
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={
+            "version": 1, "canaryFraction": 0.5, "canaryWindow": 40,
+            "canaryMinSamples": 5, "canaryPromoteAfter": 200,
+            "canaryP99Ratio": 1.5, "canaryLatencySlackS": 0.005})
+        body = await resp.json()
+        assert resp.status == 200, body
+        assert body["message"] == "Canary started"
+        assert body["warmup"]["skipped"] is None
+        assert server._canary is not None
+
+        await _drive(c, 30)
+        for _ in range(50):                  # verdict task runs off-path
+            if server._canary is None:
+                break
+            await asyncio.sleep(0.02)
+        assert server._canary is None, "latency breach must end the canary"
+        # incumbent still serving; candidate recorded ROLLED_BACK
+        assert server.instance.id == incumbent_id
+        rel = await _wait_release_status(release.id, "ROLLED_BACK")
+        assert any(h["reason"].startswith("slo_latency")
+                   for h in rel.history)
+        # both paths visible in pio_deploy_* metrics
+        m = server._deploy
+        assert m.requests_total.value(role="canary") > 0
+        assert m.requests_total.value(role="incumbent") > 0
+        assert m.rollback_total.value(reason="slo_latency") == 1
+        assert server.registry.get(
+            "pio_deploy_canary_fraction").value() == 0.0
+    finally:
+        await c.close()
+
+
+async def test_canary_error_regression_auto_rolls_back(deploy_store):
+    release = register_candidate(seed=2)
+    server = make_server(engine=make_engine(ErrorALS))
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={
+            "version": 1, "canaryFraction": 0.5, "canaryMinSamples": 5,
+            "canaryPromoteAfter": 200, "canaryErrorRateSlack": 0.2,
+            # the regressed model fails verify too — deploy cold-starts it
+            # into the canary instead of refusing? No: verify must pass, so
+            # inject errors only at scoring depth below the warmup query.
+            "warmup": False})
+        body = await resp.json()
+        # ErrorALS fails the verify health gate outright: the deploy is
+        # refused and the incumbent keeps 100% of traffic
+        assert resp.status == 500
+        assert server._canary is None
+        rel = await _wait_release_status(release.id, "ROLLED_BACK")
+        assert "prepare failed" in rel.history[-1]["reason"]
+        # the deploy body disabled warmup, so the failed swap must be
+        # labeled cold (the mode label follows the EFFECTIVE warmup flag)
+        assert server._deploy.swap_total.value(
+            mode="cold", outcome="failed") == 1
+        # traffic still healthy
+        assert all(s == 200 for s in await _drive(c, 4))
+    finally:
+        await c.close()
+
+
+class LateErrorALS(ALSAlgorithm):
+    """Passes warmup/verify (first calls succeed), then regresses —
+    the failure mode only a live SLO guard can catch. Fails the batch
+    path AND the server's per-query isolation fallback, like a truly
+    corrupt model would."""
+
+    calls = 0
+
+    def batch_predict(self, model, queries):
+        type(self).calls += 1
+        if type(self).calls > 8:
+            raise RuntimeError("late regression")
+        return super().batch_predict(model, queries)
+
+    def predict(self, model, query):
+        if type(self).calls > 8:
+            raise RuntimeError("late regression")
+        return super().predict(model, query)
+
+
+async def test_canary_late_error_regression_auto_rolls_back(deploy_store):
+    LateErrorALS.calls = 0
+    release = register_candidate(seed=2)
+    server = make_server(engine=make_engine(LateErrorALS))
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={
+            "version": 1, "canaryFraction": 0.5, "canaryMinSamples": 5,
+            "canaryPromoteAfter": 200, "canaryErrorRateSlack": 0.2})
+        assert resp.status == 200, await resp.json()
+        statuses = await _drive(c, 40)
+        for _ in range(50):
+            if server._canary is None:
+                break
+            await asyncio.sleep(0.02)
+        assert server._canary is None
+        assert server._deploy.rollback_total.value(reason="slo_errors") == 1
+        await _wait_release_status(release.id, "ROLLED_BACK")
+        # after the rollback the incumbent serves everything again
+        assert all(s == 200 for s in await _drive(c, 5))
+        assert 400 in statuses       # the regression WAS user-visible...
+    finally:
+        await c.close()
+
+
+async def test_canary_healthy_auto_promotes(deploy_store):
+    release = register_candidate(seed=3)
+    server = make_server()
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={
+            "releaseId": release.id, "canaryFraction": 0.5,
+            "canaryMinSamples": 5, "canaryPromoteAfter": 10,
+            # generous SLOs: identical models must never false-positive
+            "canaryP99Ratio": 10.0, "canaryLatencySlackS": 1.0})
+        body = await resp.json()
+        assert resp.status == 200, body
+        await _wait_release_status(release.id, "CANARY")
+
+        await _drive(c, 40)
+        for _ in range(50):
+            if server._canary is None:
+                break
+            await asyncio.sleep(0.02)
+        assert server._canary is None, "healthy canary must promote"
+        assert server.instance.id == "deploy-candidate"
+        assert server._unit.release_version == 1
+        await _wait_release_status(release.id, "LIVE")
+        m = server._deploy
+        assert m.promote_total.value(reason="healthy") == 1
+        assert m.requests_total.value(role="canary") > 0
+        assert server.registry.get(
+            "pio_deploy_active_release_version").value() == 1.0
+        # the retired incumbent is the resident standby
+        assert server._standby is not None
+        assert server._standby.instance.id == "deploy-incumbent"
+    finally:
+        await c.close()
+
+
+async def test_shadow_mode_scores_but_never_serves(deploy_store):
+    register_candidate(seed=4)
+    server = make_server(engine=make_engine(LateErrorALS))
+    LateErrorALS.calls = 100                  # regressed from the start...
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={
+            "version": 1, "shadow": True, "canaryMinSamples": 5,
+            "canaryPromoteAfter": 200, "canaryErrorRateSlack": 0.2,
+            "warmup": False,
+            # ...so skip the health gates: shadow exists to absorb
+            # exactly this blast radius
+            })
+        body = await resp.json()
+        # verify still gates even shadow deploys — reset the regression
+        # so prepare passes, then re-regress for live shadow traffic
+        if resp.status == 500:
+            LateErrorALS.calls = 0
+            resp = await c.post("/deploy.json", json={
+                "version": 1, "shadow": True, "canaryMinSamples": 5,
+                "canaryPromoteAfter": 200, "canaryErrorRateSlack": 0.2})
+            body = await resp.json()
+            assert resp.status == 200, body
+            LateErrorALS.calls = 100
+        assert server._canary is not None
+        assert server._canary.config.shadow is True
+
+        statuses = await _drive(c, 30)
+        # EVERY user-visible response came from the incumbent
+        assert all(s == 200 for s in statuses)
+        for _ in range(100):
+            if server._canary is None:
+                break
+            await asyncio.sleep(0.02)
+        assert server._canary is None
+        m = server._deploy
+        assert m.requests_total.value(role="shadow") > 0
+        assert m.requests_total.value(role="canary") == 0
+        assert m.rollback_total.value(reason="slo_errors") == 1
+    finally:
+        await c.close()
+
+
+async def test_deploy_rejects_second_concurrent_canary(deploy_store):
+    release = register_candidate(seed=3)
+    server = make_server()
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={
+            "releaseId": release.id, "canaryFraction": 0.3,
+            "canaryPromoteAfter": 10_000})
+        assert resp.status == 200, await resp.json()
+        resp = await c.post("/deploy.json", json={
+            "releaseId": release.id, "canaryFraction": 0.3})
+        assert resp.status == 409
+    finally:
+        await c.close()
+
+
+async def test_reload_refused_during_live_canary(deploy_store):
+    """A swap under a judging canary would poison the incumbent
+    baseline — /reload must refuse like /deploy does."""
+    release = register_candidate(seed=3)
+    server = make_server()
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={
+            "releaseId": release.id, "canaryFraction": 0.3,
+            "canaryPromoteAfter": 10_000})
+        assert resp.status == 200, await resp.json()
+        resp = await c.get("/reload")
+        assert resp.status == 409
+    finally:
+        await c.close()
+
+
+async def test_operator_rollback_aborts_canary(deploy_store):
+    release = register_candidate(seed=3)
+    server = make_server()
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={
+            "releaseId": release.id, "canaryFraction": 0.3,
+            "canaryPromoteAfter": 10_000})
+        assert resp.status == 200, await resp.json()
+        resp = await c.post("/rollback.json")
+        body = await resp.json()
+        assert resp.status == 200 and body["message"] == "Canary aborted"
+        assert server._canary is None
+        await _wait_release_status(release.id, "ROLLED_BACK")
+        assert server._deploy.rollback_total.value(reason="slo_latency") == 0
+    finally:
+        await c.close()
+
+
+async def test_full_deploy_then_rollback_restores_standby(deploy_store):
+    release = register_candidate(seed=3)
+    server = make_server()
+    incumbent_id = server.instance.id
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={"releaseId": release.id})
+        body = await resp.json()
+        assert resp.status == 200 and body["message"] == "Deployed", body
+        assert server.instance.id == "deploy-candidate"
+        await _wait_release_status(release.id, "LIVE")
+
+        resp = await c.post("/rollback.json")
+        body = await resp.json()
+        assert resp.status == 200 and body["message"] == "Rolled back"
+        assert server.instance.id == incumbent_id
+        await _wait_release_status(release.id, "ROLLED_BACK")
+        assert all(s == 200 for s in await _drive(c, 3))
+    finally:
+        await c.close()
+
+
+async def test_releases_and_status_endpoints(deploy_store):
+    release = register_candidate(seed=3)
+    server = make_server()
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        resp = await c.get("/releases.json")
+        body = await resp.json()
+        assert resp.status == 200
+        assert [r["version"] for r in body["releases"]] == [1]
+        assert body["releases"][0]["id"] == release.id
+        assert body["serving"]["engineInstanceId"] == "deploy-incumbent"
+
+        resp = await c.get("/deploy/status.json")
+        body = await resp.json()
+        assert body["active"]["engineInstanceId"] == "deploy-incumbent"
+        assert body["canary"] is None
+    finally:
+        await c.close()
+
+
+async def test_cli_rollback_against_live_server(deploy_store):
+    """Acceptance: `pio rollback` restores the previous release end-to-
+    end from the CLI against a live query server."""
+    from click.testing import CliRunner
+    from predictionio_tpu.cli.main import cli
+
+    release = register_candidate(seed=3)
+    server = make_server()
+    incumbent_id = server.instance.id
+    ts = TestServer(server.app)
+    c = TestClient(ts)
+    await c.start_server()
+    try:
+        resp = await c.post("/deploy.json", json={"releaseId": release.id})
+        assert resp.status == 200, await resp.json()
+        assert server.instance.id == "deploy-candidate"
+
+        loop = asyncio.get_running_loop()
+        invoke = functools.partial(
+            CliRunner().invoke, cli,
+            ["rollback", "--ip", ts.host, "--port", str(ts.port)])
+        result = await loop.run_in_executor(None, invoke)
+        assert result.exit_code == 0, result.output
+        assert "Rolled back" in result.output
+        assert incumbent_id in result.output
+        assert server.instance.id == incumbent_id
+    finally:
+        await c.close()
+
+
+async def test_admin_releases_fleet_view(deploy_store):
+    from predictionio_tpu.server.admin import create_admin_server
+
+    register_candidate(seed=3)
+    c = TestClient(TestServer(create_admin_server()))
+    await c.start_server()
+    try:
+        resp = await c.get("/cmd/releases")
+        body = await resp.json()
+        assert resp.status == 200 and body["status"] == 1
+        assert [r["version"] for r in body["releases"]] == [1]
+        assert body["releases"][0]["engineId"] == ENGINE_ID
+        resp = await c.get("/cmd/releases?engineId=no-such-engine")
+        assert (await resp.json())["releases"] == []
+    finally:
+        await c.close()
+
+
+async def test_deploy_endpoints_respect_access_key(deploy_store):
+    register_candidate(seed=3)
+    server = make_server()
+    server.access_key = "sekrit"
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        for path in ("/deploy.json", "/rollback.json"):
+            resp = await c.post(path, json={})
+            assert resp.status == 401
+        resp = await c.post("/rollback.json?accessKey=sekrit")
+        assert resp.status in (200, 404)      # authorized (no standby)
+    finally:
+        await c.close()

@@ -370,6 +370,46 @@ def test_fs_models_memory_and_local(tmp_path):
         assert ms.get("m1") is None
 
 
+def test_fs_models_insert_is_atomic_under_concurrent_get(tmp_path):
+    """A deploy-time re-insert must never expose a torn blob: insert
+    writes to a temp path and renames, so a concurrent reader sees
+    either the complete old version or the complete new one."""
+    import threading
+
+    from predictionio_tpu.storage.fs_models import FSModels
+
+    ms = FSModels(str(tmp_path / "atomic"))
+    blob_a = b"a" * 262_144
+    blob_b = b"b" * 393_216
+    ms.insert(Model(id="hot", models=blob_a))
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            got = ms.get("hot")
+            if got is not None and got.models not in (blob_a, blob_b):
+                torn.append((len(got.models), got.models[:1],
+                             got.models[-1:]))
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(25):
+            ms.insert(Model(id="hot", models=blob_b if i % 2 else blob_a))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not torn, f"reader observed torn blobs: {torn}"
+    # no temp litter left behind
+    import os
+    assert not [f for f in os.listdir(tmp_path / "atomic")
+                if ".tmp-" in f]
+
+
 def _pg_driver_available():
     for mod in ("psycopg2", "pg8000"):
         try:

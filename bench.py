@@ -42,7 +42,10 @@ Configs (order = bank cheap+judged numbers first, riskiest last):
   cooccurrence_ml1m similarproduct cooccurrence @ ML-1M shape
   naive_bayes_spam  classification NB, spam/ham scale
   ecommerce_implicit_als  implicit ALS (view+buy confidence) + top-N
-  eval_sweep_3fold_3rank  cross-validated ALS hyperparameter sweep
+  eval_sweep_grid   cross-validated ALS hyperparameter sweep: 3-fold x
+                    12-candidate (ranks x regs) grid, sequential
+                    per-candidate trains vs the device-batched
+                    vectorized sweep (compile ledger == distinct ranks)
   serving_batching  query-server hot path: concurrent-client sweep
                     (1/8/64) over the bucketed, pipelined micro-batcher,
                     p50/p99 + mean batch size + compile-shape ledger
@@ -264,19 +267,35 @@ def base_ecommerce():
     return {"baseline_s": round(base, 3), "baseline_measured_iters": measured}
 
 
+def _eval_grid_shape():
+    """The eval_sweep grid, shared by config + baseline (env-overridable
+    so the smoke test can shrink both sides identically)."""
+    nu = int(os.environ.get("BENCH_EVAL_USERS", 943))
+    ni = int(os.environ.get("BENCH_EVAL_ITEMS", 1682))
+    nnz = int(os.environ.get("BENCH_EVAL_NNZ", 100_000))
+    k_fold = int(os.environ.get("BENCH_EVAL_FOLDS", 3))
+    iters = int(os.environ.get("BENCH_EVAL_ITERS", 5))
+    ranks = [int(r) for r in
+             os.environ.get("BENCH_EVAL_RANKS", "8,12").split(",") if r]
+    regs = [float(g) for g in os.environ.get(
+        "BENCH_EVAL_REGS", "0.01,0.02,0.05,0.1,0.2,0.4").split(",") if g]
+    return nu, ni, nnz, k_fold, iters, ranks, regs
+
+
 def base_eval_sweep():
-    nu, ni, nnz = 943, 1682, 100_000
+    nu, ni, nnz, k_fold, iters, ranks, regs = _eval_grid_shape()
     users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=5)
-    k_fold, ranks, iters = 3, (8, 10, 12), 5
     fold_of = np.arange(nnz) % k_fold
-    # one fold per rank measured, x k_fold (folds are uniform cost)
+    # one fold per rank measured, then extrapolated across folds x regs
+    # (folds are uniform cost; reg does not change numpy ALS cost)
     t0 = time.perf_counter()
     for rank in ranks:
         tr = fold_of != 0
         numpy_als_baseline(users[tr], items[tr], ratings[tr], nu, ni,
                            rank, iters)
-    base = (time.perf_counter() - t0) * k_fold
-    return {"baseline_s": round(base, 3), "baseline_measured_folds": 1}
+    base = (time.perf_counter() - t0) * k_fold * len(regs)
+    return {"baseline_s": round(base, 3), "baseline_measured_folds": 1,
+            "baseline_extrapolated_candidates": len(ranks) * len(regs)}
 
 
 def base_als_ml20m():
@@ -297,7 +316,7 @@ BASELINES = {
     "cooccurrence_ml1m": base_cooccurrence,
     "naive_bayes_spam": base_naive_bayes,
     "ecommerce_implicit_als": base_ecommerce,
-    "eval_sweep_3fold_3rank": base_eval_sweep,
+    "eval_sweep_grid": base_eval_sweep,
     "als_ml20m": base_als_ml20m,
 }
 
@@ -745,53 +764,111 @@ def cfg_ecommerce(jax, mesh, platform):
 
 
 def cfg_eval_sweep(jax, mesh, platform):
-    """Config 5: 3-fold x 3-rank cross-validated ALS sweep (the numpy
-    baseline runs the identical sweep)."""
+    """Config 5: cross-validated ALS hyperparameter sweep, 3-fold x
+    12-candidate grid (ranks x regs), run BOTH ways:
+
+      * sequential — the pre-PR reference shape (MetricEvaluator loop):
+        per-fold data builds + one compiled train dispatch per
+        (candidate, fold), P x K of them.
+      * batched — the vectorized eval path (models/als_sweep): ONE
+        fold-masked data build, the whole grid as one vmapped device
+        program per distinct rank, held-out RMSE computed on device.
+
+    Asserts the batched path's XLA compile ledger equals the number of
+    distinct ranks (not grid size) and that both paths pick the same
+    best candidate; reports candidates/sec for each side.
+    """
+    from predictionio_tpu.core.cross_validation import fold_assignments
     from predictionio_tpu.models.als import ALSData, ALSParams, train_als
-    from predictionio_tpu.models.als import rmse as als_rmse
+    from predictionio_tpu.models.als_sweep import build_sweep_data, run_sweep
+    from predictionio_tpu.ops import fn_cache
 
-    nu, ni, nnz = 943, 1682, 100_000
+    nu, ni, nnz, k_fold, iters, ranks, regs = _eval_grid_shape()
     users, items, ratings = synthetic_ratings(nu, ni, nnz, seed=5)
-    k_fold, ranks, iters = 3, (8, 10, 12), 5
-    fold_of = np.arange(nnz) % k_fold
+    fold_of = fold_assignments(k_fold, nnz)
+    candidates = [ALSParams(rank=r, num_iterations=iters, reg=g,
+                            chunk_size=16384)
+                  for r in ranks for g in regs]
+    n_cand = len(candidates)
 
-    def sweep():
+    def sweep_sequential():
         # fold data is rank-independent: build + commit each fold ONCE
-        # and let every rank train on the resident arrays (the
-        # CachedEvalRunner prefix-memoization semantics, SURVEY row 30 —
-        # the reference's FastEvalEngine re-reads per train instead)
+        # per sweep and train every candidate on the resident arrays
+        # (the CachedEvalRunner prefix-memoization semantics — already
+        # generous to the sequential side)
         fold_data = []
         for f in range(k_fold):
             tr = fold_of != f
             fold_data.append(ALSData.build(
                 users[tr], items[tr], ratings[tr], nu, ni,
                 n_shards=1).put(mesh))
-        best = (None, np.inf)
-        for rank in ranks:
-            params = ALSParams(rank=rank, num_iterations=iters, reg=REG,
-                               chunk_size=16384)
-            errs = []
+        out = []
+        for p in candidates:
+            se, nt = 0.0, 0
             for f in range(k_fold):
                 te = fold_of == f
-                U, V = train_als(mesh, fold_data[f], params)
-                errs.append(als_rmse(U, V, users[te], items[te],
-                                     ratings[te]))
-            mean_err = float(np.mean(errs))
-            if mean_err < best[1]:
-                best = (rank, mean_err)
-        return best
+                U, V = train_als(mesh, fold_data[f], p)
+                pred = np.einsum("nk,nk->n", U[users[te]], V[items[te]])
+                se += float(((pred - ratings[te]) ** 2).sum())
+                nt += int(te.sum())
+            out.append((p.rank, p.reg, float(np.sqrt(se / nt))))
+        return out
 
-    hb("eval_sweep warmup (3 rank compiles)")
-    sweep()                                 # warm-up (compile per rank)
-    hb("eval_sweep timed")
-    t0 = time.perf_counter()
-    best_rank, best_err = sweep()
-    elapsed = time.perf_counter() - t0
-    flops = sum(als_model_flops(nnz * (k_fold - 1) // k_fold, nu, ni, r,
-                                iters) * k_fold for r in ranks)
-    return {"elapsed_s": round(elapsed, 4),
+    def sweep_batched():
+        data = build_sweep_data(users, items, ratings, fold_of, nu, ni)
+        res = run_sweep(data, candidates)
+        return [(c.params.rank, c.params.reg, c.heldout_rmse)
+                for c in res.candidates]
+
+    def best_of(scores):
+        return min(scores, key=lambda t: t[2])
+
+    hb(f"eval_sweep warmup sequential ({len(set(ranks))} rank compiles)")
+    sweep_sequential()
+    hb("eval_sweep timed sequential")
+    seq_s, seq_scores = timed_best(sweep_sequential, repeats=2)
+
+    hb("eval_sweep warmup batched")
+    keys_before = len(fn_cache.family_keys("als_eval_sweep"))
+    sweep_batched()
+    compile_groups = len(fn_cache.family_keys("als_eval_sweep")) \
+        - keys_before
+    # the tentpole contract: the compile ledger is bounded by distinct
+    # RANKS, not by the grid size
+    assert compile_groups == len(set(ranks)), (
+        f"batched sweep compiled {compile_groups} groups for "
+        f"{len(set(ranks))} distinct ranks ({n_cand} candidates)")
+    hb("eval_sweep timed batched")
+    bat_s, bat_scores = timed_best(sweep_batched, repeats=2)
+
+    assert best_of(seq_scores)[:2] == best_of(bat_scores)[:2], (
+        f"best-candidate parity broken: sequential {best_of(seq_scores)} "
+        f"vs batched {best_of(bat_scores)}")
+    max_diff = max(abs(a[2] - b[2])
+                   for a, b in zip(seq_scores, bat_scores))
+    best_rank, best_reg, best_err = best_of(bat_scores)
+    flops = sum(als_model_flops(nnz * (k_fold - 1) // k_fold, nu, ni,
+                                p.rank, iters) * k_fold
+                for p in candidates)
+    speedup = seq_s / bat_s if bat_s else None
+    return {"elapsed_s": round(bat_s, 4),
             "model_flops": flops,
-            "note": f"best rank {best_rank}, test-RMSE {best_err:.3f}"}
+            "grid_candidates": n_cand,
+            "k_fold": k_fold,
+            "sequential_s": round(seq_s, 4),
+            "candidates_per_s_batched": round(n_cand / bat_s, 2),
+            "candidates_per_s_sequential": round(n_cand / seq_s, 2),
+            "speedup_batched_vs_sequential": round(speedup, 2),
+            "compile_groups": compile_groups,
+            "distinct_ranks": len(set(ranks)),
+            "max_rmse_diff_vs_sequential": float(max_diff),
+            "note": (f"{n_cand}-candidate x {k_fold}-fold grid: batched "
+                     f"{n_cand / bat_s:.1f} cand/s vs sequential "
+                     f"{n_cand / seq_s:.1f} cand/s ({speedup:.1f}x); "
+                     f"{compile_groups} compile groups for "
+                     f"{len(set(ranks))} ranks; best rank {best_rank} "
+                     f"reg {best_reg} test-RMSE {best_err:.3f}, "
+                     f"max |seq-batched| RMSE diff {max_diff:.1e}")}
 
 
 def cfg_serving_batching(jax, mesh, platform):
@@ -1312,7 +1389,7 @@ CONFIGS = {
     "cooccurrence_ml1m": (cfg_cooccurrence, 240),
     "naive_bayes_spam": (cfg_naive_bayes, 180),
     "ecommerce_implicit_als": (cfg_ecommerce, 240),
-    "eval_sweep_3fold_3rank": (cfg_eval_sweep, 420),
+    "eval_sweep_grid": (cfg_eval_sweep, 420),
     "serving_batching": (cfg_serving_batching, 240),
     "deploy_swap": (cfg_deploy_swap, 240),
     "train_ingest": (cfg_train_ingest, 240),

@@ -517,15 +517,35 @@ def undeploy(ip, port, accesskey):
 @click.argument("evaluation_path")
 @click.argument("params_generator_path", required=False)
 @click.option("--batch", default="")
-def eval_cmd(evaluation_path, params_generator_path, batch):
+@click.option("--grid", "grid_specs", multiple=True, metavar="NAME=V1,V2",
+              help="Cross-product override on the algorithm params, e.g. "
+                   "--grid rank=8,12 --grid reg=0.01,0.1 (repeatable).")
+@click.option("--k-fold", "k_fold", type=int, default=None,
+              help="Override the datasource's kFold eval param.")
+@click.option("--query-num", "query_num", type=int, default=None,
+              help="Override the datasource's queryNum eval param.")
+@click.option("--sequential", is_flag=True,
+              help="Force the per-candidate sequential loop instead of "
+                   "the device-batched sweep.")
+def eval_cmd(evaluation_path, params_generator_path, batch, grid_specs,
+             k_fold, query_num, sequential):
     """Run an evaluation sweep (Console.scala:232).
 
     EVALUATION_PATH: dotted path to an Evaluation object/factory;
     PARAMS_GENERATOR_PATH: dotted path to an EngineParamsGenerator (optional
     when the Evaluation carries its own params list).
+
+    With --grid flags the supported engines execute the whole grid as a
+    few device programs (folds become zero-weight masks over one shared
+    data build; one XLA compile per distinct rank).
     """
+    import dataclasses as _dc
+    import os
+
     from predictionio_tpu.core.base import load_class
-    from predictionio_tpu.core.evaluation import Evaluation
+    from predictionio_tpu.core.evaluation import (
+        VECTORIZE_ENV, Evaluation, expand_param_grid,
+    )
     from predictionio_tpu.workflow import WorkflowParams, run_evaluation
 
     evaluation = load_class(evaluation_path)
@@ -546,11 +566,55 @@ def eval_cmd(evaluation_path, params_generator_path, batch):
     if not params_list:
         click.echo("[ERROR] No engine params to evaluate. Aborting.")
         sys.exit(1)
-    result = run_evaluation(
-        evaluation, params_list,
-        evaluation_class=evaluation_path,
-        params_generator_class=params_generator_path or "",
-        workflow_params=WorkflowParams(batch=batch))
+    try:
+        params_list = expand_param_grid(params_list, grid_specs)
+    except ValueError as e:
+        click.echo(f"[ERROR] {e}. Aborting.")
+        sys.exit(1)
+    if k_fold is not None or query_num is not None:
+        overrides = {}
+        if k_fold is not None:
+            overrides["kFold"] = k_fold
+        if query_num is not None:
+            overrides["queryNum"] = query_num
+        patched = []
+        for ep in params_list:
+            ds = ep.data_source_params
+            if not hasattr(ds, "eval_params"):
+                click.echo("[ERROR] --k-fold/--query-num need a datasource "
+                           "with eval_params. Aborting.")
+                sys.exit(1)
+            ds = _dc.replace(ds, eval_params={**(ds.eval_params or {}),
+                                              **overrides})
+            patched.append(_dc.replace(ep, data_source_params=ds))
+        params_list = patched
+    old_vectorize = os.environ.get(VECTORIZE_ENV)
+    if sequential:
+        os.environ[VECTORIZE_ENV] = "0"
+    try:
+        result = run_evaluation(
+            evaluation, params_list,
+            evaluation_class=evaluation_path,
+            params_generator_class=params_generator_path or "",
+            workflow_params=WorkflowParams(batch=batch))
+    finally:
+        if sequential:
+            if old_vectorize is None:
+                os.environ.pop(VECTORIZE_ENV, None)
+            else:
+                os.environ[VECTORIZE_ENV] = old_vectorize
+    sweep = result.sweep or {}
+    if sweep.get("mode") == "batched":
+        click.echo(f"[INFO] Sweep ran device-batched: "
+                   f"{len(params_list)} candidates in "
+                   f"{sweep.get('compileGroups')} compile group(s), "
+                   f"batch sizes {sweep.get('batchSizes')}")
+    for i, detail in enumerate(result.candidate_details):
+        _ep, score, _others = result.engine_params_scores[i]
+        click.echo(f"[INFO]   #{i}: score={score} "
+                   f"wall={detail.get('wallTimeS')}s "
+                   f"group={detail.get('group')}"
+                   + (" <- best" if i == result.best_idx else ""))
     click.echo(f"[INFO] {result.to_one_liner()}")
     click.echo("[INFO] Evaluation completed.")
 

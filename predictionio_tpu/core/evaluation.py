@@ -9,14 +9,26 @@ Parity map (reference file:line):
     rebuilt as CachedEvalRunner: datasource / preparator / per-algorithm
     train results are cached by params-JSON prefix across the sweep, the
     compilation-cache analog of FastEvalEngine's pipeline memoization
+
+Beyond the reference: the DEVICE-BATCHED sweep. When every candidate in
+the grid shares its non-algorithm params, the single algorithm supports
+``sweep_eval`` (models/als_sweep vectorized k-fold x hyperparameter
+training) and the metrics declare a device ``sweep_kind``, the whole
+grid runs as a few large device programs — one compile per distinct
+rank, folds realized as zero-weight masks over ONE shared data layout —
+instead of the reference's P x K sequential trains. Anything outside
+that contract falls back to the sequential loop unchanged
+(``PIO_EVAL_VECTORIZE=0`` forces the fallback).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.core.engine import Engine, evaluate_fold
@@ -24,6 +36,9 @@ from predictionio_tpu.core.metrics import Metric
 from predictionio_tpu.core.params import EngineParams, params_to_json
 
 logger = logging.getLogger("pio.evaluation")
+
+#: set to "0" to force the sequential per-candidate loop
+VECTORIZE_ENV = "PIO_EVAL_VECTORIZE"
 
 
 class EngineParamsGenerator:
@@ -75,7 +90,14 @@ class Evaluation:
 
 @dataclasses.dataclass
 class MetricEvaluatorResult:
-    """MetricEvaluator.scala:64-110 — scores per params with the best pick."""
+    """MetricEvaluator.scala:64-110 — scores per params with the best pick.
+
+    ``candidate_details`` (parallel to ``engine_params_scores``) carries
+    per-candidate wall time and the compile group that trained it —
+    persisted into ``evaluator_results_json`` so `pio eval` output and
+    the dashboard can show where sweep time went. ``sweep`` summarizes
+    the execution (mode, compile groups, device batch sizes).
+    """
 
     best_score: float
     best_engine_params: EngineParams
@@ -83,6 +105,8 @@ class MetricEvaluatorResult:
     metric_header: str
     other_metric_headers: List[str]
     engine_params_scores: List[Tuple[EngineParams, float, List[float]]]
+    candidate_details: List[dict] = dataclasses.field(default_factory=list)
+    sweep: Optional[dict] = None
 
     def to_one_liner(self) -> str:
         return f"[{self.metric_header}] {self.best_score}"
@@ -97,6 +121,8 @@ class MetricEvaluatorResult:
             "engineParamsScores": [
                 {"engineParams": ep.to_json_dict(), "score": s, "others": o}
                 for ep, s, o in self.engine_params_scores],
+            "candidates": self.candidate_details,
+            "sweep": self.sweep,
         }
 
     def to_json(self) -> str:
@@ -174,6 +200,156 @@ def _jsonable(p: Any) -> Any:
         return repr(p)
 
 
+# ---------------------------------------------------------------------------
+# Device-batched sweep plumbing
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class EvalGrid:
+    """What a DataSource hands the vectorized sweep instead of K
+    materialized folds: the FULL eval data (engine-specific payload,
+    e.g. rating columns) plus the fold count and per-query settings.
+    Produced by an optional ``DataSource.read_eval_grid(ctx)``."""
+
+    data: Any
+    k_fold: int
+    query_num: int = 10
+
+
+def expand_param_grid(engine_params_list: Sequence[EngineParams],
+                      grid_specs: Sequence[str]) -> List[EngineParams]:
+    """Cross-product hyperparameter expansion for `pio eval --grid`.
+
+    Each spec is ``name=v1,v2,...`` overriding a field of the (single)
+    algorithm's params; the result is base-params x the full cross
+    product, in deterministic order. Values parse as JSON scalars when
+    possible (ints/floats/bools), else strings.
+    """
+    if not grid_specs:
+        return list(engine_params_list)
+    dims: List[Tuple[str, List[Any]]] = []
+    for spec in grid_specs:
+        name, sep, vals = spec.partition("=")
+        name = name.strip()
+        values = [v for v in vals.split(",") if v.strip()]
+        if not sep or not name or not values:
+            raise ValueError(
+                f"--grid spec {spec!r}: expected name=v1,v2,...")
+        if any(n == name for n, _ in dims):
+            # last-spec-wins would silently drop half the grid
+            raise ValueError(f"--grid field {name!r} specified twice")
+        parsed = []
+        for v in values:
+            try:
+                parsed.append(json.loads(v))
+            except json.JSONDecodeError:
+                parsed.append(v.strip())
+        dims.append((name, parsed))
+    out: List[EngineParams] = []
+    for ep in engine_params_list:
+        if len(ep.algorithm_params_list) != 1:
+            raise ValueError(
+                "--grid requires exactly one algorithm per EngineParams "
+                f"(got {len(ep.algorithm_params_list)})")
+        algo_name, algo_params = ep.algorithm_params_list[0]
+        for f, _vals in dims:
+            if not hasattr(algo_params, f):
+                raise ValueError(
+                    f"--grid field {f!r} is not a parameter of "
+                    f"{type(algo_params).__name__}")
+        for combo in itertools.product(*[vals for _n, vals in dims]):
+            new_ap = dataclasses.replace(
+                algo_params, **{n: v for (n, _), v in zip(dims, combo)})
+            out.append(dataclasses.replace(
+                ep, algorithm_params_list=[(algo_name, new_ap)]))
+    return out
+
+
+def sweep_kind_of(metric: Metric) -> Optional[str]:
+    """The metric's device ``sweep_kind``, or None when it must stay on
+    the sequential path.
+
+    Guards against silent inheritance: a subclass that overrides
+    ``calculate``/``calculate_point`` (custom math the device kernel
+    knows nothing about) WITHOUT re-declaring ``sweep_kind`` in its own
+    body would otherwise inherit the parent's kind and get the stock
+    device computation instead of its override. The rule: ``sweep_kind``
+    counts only if it is declared at or below the most-derived class
+    that overrides the calculation methods.
+    """
+    cls = type(metric)
+    kind_cls = next((k for k in cls.__mro__ if "sweep_kind" in k.__dict__),
+                    None)
+    if kind_cls is None or kind_cls.__dict__["sweep_kind"] is None:
+        return None
+    for klass in cls.__mro__:
+        if klass is kind_cls:
+            return kind_cls.__dict__["sweep_kind"]
+        if "calculate" in klass.__dict__ \
+                or "calculate_point" in klass.__dict__:
+            return None       # customized math below the declaration
+    return None
+
+
+def _try_vectorized_sweep(ctx, engine: Engine,
+                          engine_params_list: Sequence[EngineParams],
+                          metric: Metric, other_metrics: Sequence[Metric]):
+    """The device-batched sweep, when the grid fits its contract; None
+    when it doesn't (the caller falls back to the sequential loop).
+
+    Contract: every metric declares a ``sweep_kind``; every candidate
+    shares datasource/preparator/serving params and carries exactly ONE
+    algorithm (same name across the grid); the algorithm implements
+    ``sweep_eval`` and the datasource ``read_eval_grid``. Structural
+    mismatches return None cheaply (no jax import, no data read); real
+    errors past that point propagate — a broken sweep must fail loudly,
+    not silently retrain P x K times.
+    """
+    if os.environ.get(VECTORIZE_ENV, "1") == "0":
+        return None
+    all_metrics = [metric, *other_metrics]
+    if any(sweep_kind_of(m) is None for m in all_metrics):
+        return None
+    eps = list(engine_params_list)
+    shared = CachedEvalRunner._key(
+        eps[0].data_source_name, eps[0].data_source_params,
+        eps[0].preparator_name, eps[0].preparator_params,
+        eps[0].serving_name, eps[0].serving_params)
+    for ep in eps:
+        if len(ep.algorithm_params_list) != 1:
+            return None
+        if CachedEvalRunner._key(
+                ep.data_source_name, ep.data_source_params,
+                ep.preparator_name, ep.preparator_params,
+                ep.serving_name, ep.serving_params) != shared:
+            return None
+    algo_names = {ep.algorithm_params_list[0][0] for ep in eps}
+    if len(algo_names) != 1:
+        return None
+    name, algo = engine._algorithms(eps[0])[0]
+    if not hasattr(algo, "sweep_eval"):
+        return None
+    data_source = engine._data_source(eps[0])
+    if not hasattr(data_source, "read_eval_grid"):
+        return None
+
+    from predictionio_tpu.obs.registry import default_registry
+    from predictionio_tpu.obs.tracing import span
+
+    registry = default_registry()
+    with span("eval_split", registry):
+        grid = data_source.read_eval_grid(ctx)
+    algo_params = [ep.algorithm_params_list[0][1] for ep in eps]
+    sweep = algo.sweep_eval(ctx, grid, algo_params, metric,
+                            other_metrics=other_metrics, registry=registry)
+    if sweep is None:      # the algorithm declined (unsupported combo)
+        return None
+    logger.info("vectorized eval sweep: %d candidates x %d folds in %d "
+                "compile group(s)", len(eps), grid.k_fold,
+                sweep["info"].get("compileGroups", 0))
+    return sweep
+
+
 class MetricEvaluator:
     """MetricEvaluator.scala:185 — score every engine params, pick the best."""
 
@@ -188,16 +364,41 @@ class MetricEvaluator:
                  ) -> MetricEvaluatorResult:
         if not engine_params_list:
             raise ValueError("engine_params_list must not be empty")
-        runner = CachedEvalRunner(engine)
         scores: List[Tuple[EngineParams, float, List[float]]] = []
-        for i, ep in enumerate(engine_params_list):
-            eval_data = runner.eval(ctx, ep)
-            score = self.metric.calculate(ctx, eval_data)
-            others = [m.calculate(ctx, eval_data) for m in self.other_metrics]
-            logger.info("engine params %d/%d: %s = %s",
-                        i + 1, len(engine_params_list),
-                        self.metric.header(), score)
-            scores.append((ep, score, others))
+        details: List[dict] = []
+        sweep_info: Optional[dict] = None
+
+        vec = _try_vectorized_sweep(ctx, engine, engine_params_list,
+                                    self.metric, self.other_metrics)
+        if vec is not None:
+            for i, (ep, (score, others)) in enumerate(
+                    zip(engine_params_list, vec["scores"])):
+                scores.append((ep, score, list(others)))
+                details.append({"index": i, **vec["details"][i]})
+            sweep_info = vec["info"]
+        else:
+            from predictionio_tpu.obs.eval_stats import (
+                eval_candidates_counter,
+            )
+
+            runner = CachedEvalRunner(engine)
+            for i, ep in enumerate(engine_params_list):
+                t0 = time.perf_counter()
+                eval_data = runner.eval(ctx, ep)
+                score = self.metric.calculate(ctx, eval_data)
+                others = [m.calculate(ctx, eval_data)
+                          for m in self.other_metrics]
+                logger.info("engine params %d/%d: %s = %s",
+                            i + 1, len(engine_params_list),
+                            self.metric.header(), score)
+                scores.append((ep, score, others))
+                details.append({
+                    "index": i, "group": "sequential",
+                    "wallTimeS": round(time.perf_counter() - t0, 4)})
+            eval_candidates_counter().inc(len(engine_params_list),
+                                          mode="sequential")
+            sweep_info = {"mode": "sequential", "compileGroups": None,
+                          "batchSizes": []}
 
         import math
 
@@ -218,7 +419,9 @@ class MetricEvaluator:
             best_idx=best_idx,
             metric_header=self.metric.header(),
             other_metric_headers=[m.header() for m in self.other_metrics],
-            engine_params_scores=scores)
+            engine_params_scores=scores,
+            candidate_details=details,
+            sweep=sweep_info)
         if self.output_path:
             self._save_best_json(best_ep)
         return result

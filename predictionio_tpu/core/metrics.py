@@ -23,9 +23,18 @@ EvalDataSet = Sequence[Tuple[EI, Sequence[Tuple[Q, P, A]]]]
 
 
 class Metric(Generic[EI, Q, P, A, R], abc.ABC):
-    """Metric.scala:39. Higher is better by default; set smaller_is_better."""
+    """Metric.scala:39. Higher is better by default; set smaller_is_better.
+
+    ``sweep_kind`` opts a metric into the device-batched evaluation sweep
+    (core/evaluation.py): a metric that names one of the kinds an
+    algorithm's ``sweep_eval`` can compute on device ("precision_at_k",
+    "topn_mse", "zero") is evaluated in batch over the whole candidate
+    grid instead of through per-fold Q/P/A loops. ``None`` (the default)
+    keeps the metric on the sequential path.
+    """
 
     smaller_is_better: bool = False
+    sweep_kind = None  # type: Optional[str]
 
     @abc.abstractmethod
     def calculate(self, ctx, eval_data_set: EvalDataSet) -> R: ...
@@ -94,6 +103,8 @@ class SumMetric(_PointMetric):
 
 class ZeroMetric(Metric):
     """Metric.scala:234 — always 0; for evaluations without a real metric."""
+
+    sweep_kind = "zero"
 
     def calculate(self, ctx, eval_data_set: EvalDataSet) -> float:
         return 0.0

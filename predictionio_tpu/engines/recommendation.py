@@ -238,6 +238,18 @@ class RecommendationDataSource(DataSource):
             folds.append((TrainingData(ratings=train), {"fold": fold}, qa))
         return folds
 
+    def read_eval_grid(self, ctx):
+        """ONE read for the whole device-batched sweep: the full rating
+        columns plus fold count — the vectorized evaluator derives fold
+        membership as index-mod-k mask columns (the same assignment
+        `read_eval` uses) instead of materializing K data subsets."""
+        from predictionio_tpu.core.evaluation import EvalGrid
+
+        ep = self.params.eval_params or {}
+        return EvalGrid(data=self._read_columns(),
+                        k_fold=int(ep.get("kFold", 3)),
+                        query_num=int(ep.get("queryNum", 10)))
+
 
 class RecommendationPreparator(Preparator):
     """Template passthrough preparator (Preparator.scala parity)."""
@@ -337,6 +349,81 @@ class ALSAlgorithm(Algorithm):
             return None
         return Query(user=str(model.user_vocab[0]), num=10)
 
+    #: device metric kinds `sweep_eval` can compute
+    SWEEP_KINDS = ("precision_at_k", "topn_mse", "zero")
+
+    def sweep_eval(self, ctx, grid, algo_params_list, metric,
+                   other_metrics=(), registry=None):
+        """Device-batched k-fold x hyperparameter sweep (the vectorized
+        `pio eval` path): every (candidate, fold) unit trains in one
+        vmapped program per distinct rank over a single shared
+        fold-masked data layout, and metrics are computed on device in
+        batch (models/als_sweep). Returns the evaluator's sweep contract
+        ({scores, details, info}) or None to decline.
+        """
+        import jax
+
+        if jax.process_count() > 1:
+            # multi-process reads are sharded per process; the sweep
+            # builds from ONE process's view, so fall back to the
+            # distributed-aware sequential path
+            return None
+        from predictionio_tpu.core.evaluation import sweep_kind_of
+
+        metrics = [metric, *other_metrics]
+        kinds = [sweep_kind_of(m) for m in metrics]
+        if any(k not in self.SWEEP_KINDS for k in kinds):
+            return None
+        prec_specs = {(m.k, m.rating_threshold)
+                      for m, k in zip(metrics, kinds)
+                      if k == "precision_at_k"}
+        if len(prec_specs) > 1:       # one rank pass per sweep
+            return None
+
+        from predictionio_tpu.core.cross_validation import fold_assignments
+        from predictionio_tpu.models.als_sweep import (
+            build_sweep_data, run_sweep,
+        )
+        from predictionio_tpu.obs.tracing import span
+
+        cols = grid.data
+        fold_of = fold_assignments(grid.k_fold, len(cols))
+        with span("eval_build", registry):
+            user_vocab, user_codes = assign_indices(cols.users)
+            item_vocab, item_codes = assign_indices(cols.items)
+            data = build_sweep_data(
+                user_codes, item_codes, cols.values, fold_of,
+                len(user_vocab), len(item_vocab))
+        candidates = [ALSParams(
+            rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
+            seed=p.seed, implicit_prefs=p.implicit_prefs, alpha=p.alpha)
+            for p in algo_params_list]
+        needs_rank = any(k in ("precision_at_k", "topn_mse") for k in kinds)
+        if prec_specs:
+            pk, threshold = next(iter(prec_specs))
+        else:
+            pk, threshold = grid.query_num, 2.0
+        rank_spec = ((grid.query_num, pk, threshold)
+                     if needs_rank else None)
+        result = run_sweep(data, candidates, rank_metrics=rank_spec,
+                           registry=registry)
+
+        def score_of(m, c):
+            kind = sweep_kind_of(m)
+            if kind == "precision_at_k":
+                return c.precision
+            if kind == "topn_mse":
+                return c.topn_mse
+            return 0.0
+
+        scores = [(score_of(metric, c),
+                   [score_of(m, c) for m in other_metrics])
+                  for c in result.candidates]
+        details = [c.to_json_dict() for c in result.candidates]
+        info = {"mode": result.mode, "compileGroups": result.n_groups,
+                "batchSizes": result.batch_sizes, "kFold": grid.k_fold}
+        return {"scores": scores, "details": details, "info": info}
+
     def batch_predict(self, model: ALSModel, queries):
         """Vectorized: one device matmul for the whole batch — the eval /
         micro-batch fast path (vs CreateServer.scala:508 serial loop)."""
@@ -359,6 +446,8 @@ class RecommendationServing(FirstServing):
 class PrecisionAtK(OptionAverageMetric):
     """Evaluation.scala:32-105 — fraction of top-k that are 'positive'
     (actual rating >= threshold); None when the actual is not rateable."""
+
+    sweep_kind = "precision_at_k"
 
     def __init__(self, k: int = 10, rating_threshold: float = 2.0):
         self.k = k
@@ -383,6 +472,7 @@ class RMSEMetric(AverageMetric):
     """Held-out squared error of the predicted rating for (user, item)."""
 
     smaller_is_better = True
+    sweep_kind = "topn_mse"
 
     def header(self) -> str:
         return "MSE (sqrt for RMSE)"

@@ -87,16 +87,16 @@ def _auto_row_len(nnz: int, n_segments: int) -> int:
     return int(min(512, max(16, 1 << int(np.ceil(np.log2(mean))))))
 
 
-def _build_rows(seg_local: np.ndarray, tgt: np.ndarray, val: np.ndarray,
-                weights: Optional[np.ndarray], row_len: int,
-                seg_per_shard: int):
-    """Pack one shard's (sorted-by-segment) ratings into padded rows."""
+def _row_positions(seg_local: np.ndarray, row_len: int,
+                   seg_per_shard: int):
+    """Packing positions for sorted-by-segment ratings: (rrow, col,
+    n_rows, row_seg), where element j lands at [rrow[j], col[j]] of an
+    [n_rows, row_len] padded-row array. Shared by the training build and
+    the eval sweep's auxiliary columns (fold ids packed into the SAME
+    layout). n == 0 degrades to one all-padding row (rrow/col None)."""
     n = len(seg_local)
     if n == 0:
-        return (np.zeros((1, row_len), np.int32),
-                np.zeros((1, row_len), np.float32),
-                np.zeros((1, row_len), np.float32),
-                np.full((1,), seg_per_shard - 1, np.int32))
+        return None, None, 1, np.full((1,), seg_per_shard - 1, np.int32)
     # the input is SORTED by segment (both callers sort first), so the
     # group structure falls out of one linear diff pass — np.unique would
     # re-sort 20M elements it already received in order
@@ -113,13 +113,23 @@ def _build_rows(seg_local: np.ndarray, tgt: np.ndarray, val: np.ndarray,
     rrow = row_start[inv] + pos // row_len
     col = pos % row_len
     n_rows = int(row_start[-1])
+    row_seg = np.repeat(uniq, rows_per).astype(np.int32)
+    return rrow, col, n_rows, row_seg
+
+
+def _build_rows(seg_local: np.ndarray, tgt: np.ndarray, val: np.ndarray,
+                weights: Optional[np.ndarray], row_len: int,
+                seg_per_shard: int):
+    """Pack one shard's (sorted-by-segment) ratings into padded rows."""
+    rrow, col, n_rows, row_seg = _row_positions(seg_local, row_len,
+                                                seg_per_shard)
     tgt_out = np.zeros((n_rows, row_len), np.int32)
     val_out = np.zeros((n_rows, row_len), np.float32)
     w_out = np.zeros((n_rows, row_len), np.float32)
-    tgt_out[rrow, col] = tgt
-    val_out[rrow, col] = val
-    w_out[rrow, col] = weights if weights is not None else 1.0
-    row_seg = np.repeat(uniq, rows_per).astype(np.int32)
+    if rrow is not None:
+        tgt_out[rrow, col] = tgt
+        val_out[rrow, col] = val
+        w_out[rrow, col] = weights if weights is not None else 1.0
     return tgt_out, val_out, w_out, row_seg
 
 
@@ -284,12 +294,17 @@ class ALSData:
 # Device sweeps
 # ---------------------------------------------------------------------------
 
-def _half_sweep(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
-                seg_per_shard: int, params: ALSParams,
-                chunk_rows: int) -> jax.Array:
+def _half_sweep_dyn(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
+                    seg_per_shard: int, *, reg, alpha,
+                    implicit_prefs: bool, weighted_reg: bool,
+                    alpha_is_zero: bool, chunk_rows: int) -> jax.Array:
     """Solve this side's factors for one shard. opposite is the full
-    (replicated) opposite-side factor matrix; rows are the padded ALX layout."""
-    if params.implicit_prefs:
+    (replicated) opposite-side factor matrix; rows are the padded ALX
+    layout. ``reg``/``alpha`` may be python floats OR traced scalars —
+    the device-batched eval sweep vmaps this body over a candidate axis
+    of (reg, alpha) values, so only the program-SHAPING flags
+    (implicit_prefs / weighted_reg / alpha_is_zero) are static."""
+    if implicit_prefs:
         # Hu-Koren-Volinsky: preference p = [r > 0], confidence
         # c = 1 + alpha * |r| (negative r = confident dislike, the
         # similarproduct LikeAlgorithm convention).
@@ -300,13 +315,13 @@ def _half_sweep(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
         # rhs is a plain preference sum — use a direct pass for that case.
         gram_all = opposite.T @ opposite                 # [K, K] MXU
         p = jnp.where(row_val > 0, 1.0, 0.0)
-        if params.alpha == 0:
+        if alpha_is_zero:
             gram, rhs, cnt = rows_gram_rhs(
                 opposite, row_tgt, row_seg, row_val=p, row_w=row_w,
                 num_segments=seg_per_shard, chunk_rows=chunk_rows)
             gram = jnp.zeros_like(gram)  # (c-1) = 0; keep only the rhs
         else:
-            cm1 = params.alpha * jnp.abs(row_val)        # c - 1
+            cm1 = alpha * jnp.abs(row_val)               # c - 1
             vals = jnp.where(cm1 > 0,
                              (1.0 + cm1) * p / jnp.maximum(cm1, 1e-12), 0.0)
             gram, rhs, _ = rows_gram_rhs(
@@ -315,15 +330,27 @@ def _half_sweep(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
                 num_segments=seg_per_shard, chunk_rows=chunk_rows)
             cnt = segment_count(row_seg, row_w.sum(axis=1), seg_per_shard)
         A = gram_all[None, :, :] + gram
-        lam = params.reg * jnp.where(params.weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
+        lam = reg * jnp.where(weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
         A = A + lam[:, None, None] * jnp.eye(opposite.shape[1], dtype=A.dtype)
         return batched_spd_solve(A, rhs)
     gram, rhs, cnt = rows_gram_rhs(
         opposite, row_tgt, row_seg, row_val=row_val, row_w=row_w,
         num_segments=seg_per_shard, chunk_rows=chunk_rows)
-    lam = params.reg * jnp.where(params.weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
+    lam = reg * jnp.where(weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
     A = gram + lam[:, None, None] * jnp.eye(opposite.shape[1], dtype=gram.dtype)
     return batched_spd_solve(A, rhs)
+
+
+def _half_sweep(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
+                seg_per_shard: int, params: ALSParams,
+                chunk_rows: int) -> jax.Array:
+    """Static-params wrapper over `_half_sweep_dyn` (the training path)."""
+    return _half_sweep_dyn(
+        opposite, row_tgt, row_seg, row_val, row_w, seg_per_shard,
+        reg=params.reg, alpha=params.alpha,
+        implicit_prefs=params.implicit_prefs,
+        weighted_reg=params.weighted_reg,
+        alpha_is_zero=(params.alpha == 0), chunk_rows=chunk_rows)
 
 
 def _make_sweeps(mesh: Mesh, data_dims, params: ALSParams):

@@ -1,0 +1,577 @@
+"""Device-batched k-fold x hyperparameter ALS evaluation sweep.
+
+The reference MetricEvaluator loops param-sets x folds in Python,
+rebuilding training data and paying a fresh XLA compile per candidate
+(MetricEvaluator.scala:218 evaluateBase). ALX (arXiv:2112.02194) shows
+ALS-family training is bandwidth-bound enough that batching independent
+problems into one compiled program is nearly free, and iALS++
+(arXiv:2110.14044) shows the hyperparameter sweep — not a single train —
+dominates real matrix-factorization cost. So this module executes the
+whole grid as a few large device programs:
+
+* the fold split is built ONCE as fold-id columns packed into a single
+  shared padded-row layout (`build_sweep_data`); per-fold training
+  weights are computed on device as ``w * (fold_ids != fold)`` — test
+  entries zero-weighted, same sparsity pattern, no per-fold data builds;
+* training is ``vmap``-ed over a stacked leading axis of
+  (candidate x fold) units covering every shape-PRESERVING
+  hyperparameter (reg, alpha, seed, num_iterations); only shape-CHANGING
+  params (rank, plus the program-shaping implicit/weighted-reg flags)
+  split the grid into compile groups, so the XLA compile ledger
+  (``pio_jax_compile_total{family=als_eval_sweep}``) is bounded by the
+  number of distinct ranks, not by grid size;
+* metrics (held-out RMSE, precision@k, top-N MSE) are computed on device
+  in batch over the same leading axis; only one small sums tensor per
+  launch is gathered to host;
+* multi-process runs split compile groups round-robin across processes
+  (the existing ``parallel/shuffle.allgather_object`` protocol) and
+  merge the per-candidate score dicts; single-process multi-device runs
+  shard the unit axis across local devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.models.als import (
+    ALSParams, _auto_row_len, _half_sweep_dyn, _row_positions,
+)
+from predictionio_tpu.obs.eval_stats import (
+    eval_batch_size, eval_candidates_counter, eval_compile_groups,
+)
+from predictionio_tpu.obs.registry import default_registry
+from predictionio_tpu.obs.tracing import span
+from predictionio_tpu.ops.fn_cache import shape_cached_fn
+
+#: compile-ledger families: one entry per compile group (train) plus one
+#: per group for the metric kernel — kept separate so the
+#: "compile count == distinct ranks" contract is assertable on the
+#: train family alone
+TRAIN_FAMILY = "als_eval_sweep"
+METRIC_FAMILY = "als_eval_metric"
+
+#: units (candidate x fold) per compiled launch; grids larger than this
+#: split into equal-size launches so one compile still covers them all
+BATCH_MAX_ENV = "PIO_EVAL_BATCH_MAX"
+_DEFAULT_BATCH_MAX = 256
+
+#: per-chunk device buffer budget for the scan bodies (the vmapped
+#: gather/score buffers scale with units x chunk). 256 MiB lets typical
+#: eval-scale grids run each half-sweep as ONE un-chunked pass (measured
+#: ~25% faster on CPU than 64 MiB chunking); grids big enough to exceed
+#: it degrade to chunked scans instead of OOMing. PIO_EVAL_CHUNK_MB
+#: overrides for small-HBM devices.
+_CHUNK_BUDGET_BYTES = int(os.environ.get("PIO_EVAL_CHUNK_MB", 256)) << 20
+
+
+# ---------------------------------------------------------------------------
+# Shared fold-masked data layout
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SweepSide:
+    """One side's padded rows + the fold id of every rating, packed into
+    the SAME positions as the values (fold -1 = padding, never a fold)."""
+
+    tgt: np.ndarray    # int32 [R, L]
+    seg: np.ndarray    # int32 [R]
+    val: np.ndarray    # float32 [R, L]
+    w: np.ndarray      # float32 [R, L] (0 = padding)
+    fold: np.ndarray   # int32 [R, L] (-1 = padding)
+    n_segments: int
+    row_len: int
+
+
+@dataclasses.dataclass
+class ALSSweepData:
+    """The whole grid's training data, built once: both padded-row sides
+    with fold columns, plus the host COO (for the metric kernels)."""
+
+    by_user: SweepSide
+    by_item: SweepSide
+    user_idx: np.ndarray   # int32 [nnz]
+    item_idx: np.ndarray   # int32 [nnz]
+    ratings: np.ndarray    # float32 [nnz]
+    fold_of: np.ndarray    # int32 [nnz]
+    n_users: int
+    n_items: int
+    nnz: int
+    k_folds: int
+
+
+def _pack_sweep_side(seg_idx, tgt_idx, values, fold_of, n_segments,
+                     row_len) -> SweepSide:
+    order = np.argsort(seg_idx, kind="stable")
+    rrow, col, n_rows, row_seg = _row_positions(
+        seg_idx[order].astype(np.int64), row_len, n_segments)
+    tgt = np.zeros((n_rows, row_len), np.int32)
+    val = np.zeros((n_rows, row_len), np.float32)
+    w = np.zeros((n_rows, row_len), np.float32)
+    fold = np.full((n_rows, row_len), -1, np.int32)
+    if rrow is not None:
+        tgt[rrow, col] = tgt_idx[order]
+        val[rrow, col] = values[order]
+        w[rrow, col] = 1.0
+        fold[rrow, col] = fold_of[order]
+    return SweepSide(tgt=tgt, seg=row_seg, val=val, w=w, fold=fold,
+                     n_segments=n_segments, row_len=row_len)
+
+
+def build_sweep_data(user_idx: np.ndarray, item_idx: np.ndarray,
+                     ratings: np.ndarray, fold_of: np.ndarray,
+                     n_users: int, n_items: int,
+                     row_len: Optional[int] = None) -> ALSSweepData:
+    """Pack the FULL rating set once; fold membership rides along as a
+    packed column instead of producing k separate data builds."""
+    user_idx = np.ascontiguousarray(user_idx, np.int32)
+    item_idx = np.ascontiguousarray(item_idx, np.int32)
+    ratings = np.ascontiguousarray(ratings, np.float32)
+    fold_of = np.ascontiguousarray(fold_of, np.int32)
+    nnz = len(ratings)
+    if row_len is None:
+        row_len = _auto_row_len(nnz, max(n_users, n_items))
+    return ALSSweepData(
+        by_user=_pack_sweep_side(user_idx, item_idx, ratings, fold_of,
+                                 n_users, row_len),
+        by_item=_pack_sweep_side(item_idx, user_idx, ratings, fold_of,
+                                 n_items, row_len),
+        user_idx=user_idx, item_idx=item_idx, ratings=ratings,
+        fold_of=fold_of, n_users=n_users, n_items=n_items, nnz=nnz,
+        k_folds=int(fold_of.max()) + 1 if nnz else 0)
+
+
+# ---------------------------------------------------------------------------
+# Compile grouping
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class GroupStatic:
+    """Everything that shapes the compiled program. Candidates differing
+    only in reg/alpha/seed/num_iterations share a group (and a compile);
+    each distinct rank is its own group."""
+
+    rank: int
+    implicit_prefs: bool
+    weighted_reg: bool
+    alpha_is_zero: bool
+    chunk_size: int
+
+    @property
+    def label(self) -> str:
+        return f"rank={self.rank}" + \
+            ("/implicit" if self.implicit_prefs else "")
+
+
+def group_candidates(candidates: Sequence[ALSParams]
+                     ) -> "OrderedDict[GroupStatic, List[int]]":
+    groups: "OrderedDict[GroupStatic, List[int]]" = OrderedDict()
+    for i, p in enumerate(candidates):
+        key = GroupStatic(
+            rank=int(p.rank), implicit_prefs=bool(p.implicit_prefs),
+            weighted_reg=bool(p.weighted_reg),
+            alpha_is_zero=bool(p.implicit_prefs and p.alpha == 0),
+            chunk_size=int(p.chunk_size))
+        groups.setdefault(key, []).append(i)
+    return groups
+
+
+def _chunk_for_budget(per_element_bytes: int, n_rows: int) -> int:
+    """Largest power-of-two chunk whose vmapped buffer fits the budget."""
+    c = max(64, _CHUNK_BUDGET_BYTES // max(per_element_bytes, 1))
+    c = 1 << int(np.floor(np.log2(c)))
+    return int(min(max(n_rows, 1), c))
+
+
+# ---------------------------------------------------------------------------
+# Compiled kernels (built per group, registered in the compile ledger)
+# ---------------------------------------------------------------------------
+
+def _build_train_fn(static: GroupStatic, n_users: int, n_items: int,
+                    max_iters: int, b: int, shapes, use_v0: bool):
+    """jit(train) over a [b] unit axis: data args broadcast, candidate
+    args (fold, reg, alpha, iters, init) vmapped."""
+    import jax
+    import jax.numpy as jnp
+
+    k = static.rank
+    (r_u, r_i, row_len) = shapes
+    # the vmapped gather buffer inside rows_gram_rhs is [b, C, L, K]
+    chunk_u = min(static.chunk_size, _chunk_for_budget(
+        b * row_len * k * 4, r_u))
+    chunk_i = min(static.chunk_size, _chunk_for_budget(
+        b * row_len * k * 4, r_i))
+
+    def train_batch(u_tgt, u_seg, u_val, u_w, u_fold,
+                    i_tgt, i_seg, i_val, i_w, i_fold,
+                    fold_c, reg_c, alpha_c, iters_c, init_c):
+        def one(fold, reg, alpha, iters_n, init):
+            # the fold split, applied on device: test entries zero-weight
+            uw = u_w * (u_fold != fold)
+            iw = i_w * (i_fold != fold)
+            if use_v0:
+                V = init
+            else:
+                key = jax.random.PRNGKey(init)
+                V = (jax.random.normal(key, (n_items, k), jnp.float32)
+                     / jnp.sqrt(jnp.asarray(k, jnp.float32)))
+
+            def body(i, carry):
+                U, V = carry
+                U2 = _half_sweep_dyn(
+                    V, u_tgt, u_seg, u_val, uw, n_users,
+                    reg=reg, alpha=alpha,
+                    implicit_prefs=static.implicit_prefs,
+                    weighted_reg=static.weighted_reg,
+                    alpha_is_zero=static.alpha_is_zero,
+                    chunk_rows=chunk_u)
+                V2 = _half_sweep_dyn(
+                    U2, i_tgt, i_seg, i_val, iw, n_items,
+                    reg=reg, alpha=alpha,
+                    implicit_prefs=static.implicit_prefs,
+                    weighted_reg=static.weighted_reg,
+                    alpha_is_zero=static.alpha_is_zero,
+                    chunk_rows=chunk_i)
+                # units may carry fewer iterations than the group max:
+                # finished units freeze their factors
+                keep = i < iters_n
+                return (jnp.where(keep, U2, U), jnp.where(keep, V2, V))
+
+            U0 = jnp.zeros((n_users, k), jnp.float32)
+            return jax.lax.fori_loop(0, max_iters, body, (U0, V))
+
+        return jax.vmap(one)(fold_c, reg_c, alpha_c, iters_c, init_c)
+
+    return jax.jit(train_batch)
+
+
+def _build_metric_fn(rank: int, n_items: int, n_pad: int, b: int,
+                     rank_spec: Optional[Tuple[int, int, float]]):
+    """jit(metrics) over the same [b] unit axis; returns per-unit raw
+    sums so folds pool EXACTLY like the sequential metric (points
+    flattened across folds before averaging).
+
+    Always: held-out squared error + test count over the COO entries.
+    With ``rank_spec`` (query_num, precision_k, threshold): additionally
+    the full-catalog rank of each held-out item, for precision@k and the
+    top-N-masked MSE the DASE metrics compute.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if rank_spec is None:
+        chunk = _chunk_for_budget(b * max(rank, 1) * 4, n_pad)
+    else:
+        # the [b, C, n_items] score buffer dominates
+        chunk = _chunk_for_budget(b * n_items * 4, n_pad)
+    n_chunks = -(-n_pad // chunk)
+
+    def metric_batch(U, V, u_idx, i_idx, val, fold_e, fold_c):
+        tail = n_chunks * chunk - n_pad
+        # pad to a chunk multiple; fold -1 marks never-test entries
+        u_p = jnp.concatenate([u_idx, jnp.zeros(tail, u_idx.dtype)])
+        i_p = jnp.concatenate([i_idx, jnp.zeros(tail, i_idx.dtype)])
+        v_p = jnp.concatenate([val, jnp.zeros(tail, val.dtype)])
+        f_p = jnp.concatenate([fold_e, jnp.full(tail, -1, fold_e.dtype)])
+        slabs = (u_p.reshape(n_chunks, chunk),
+                 i_p.reshape(n_chunks, chunk),
+                 v_p.reshape(n_chunks, chunk),
+                 f_p.reshape(n_chunks, chunk))
+
+        if rank_spec is None:
+            def one(Ub, Vb, fold):
+                def body(carry, sl):
+                    u, i, v, f = sl
+                    pred = jnp.sum(Ub[u] * Vb[i], axis=1)
+                    test = (f == fold).astype(jnp.float32)
+                    se, nt = carry
+                    return (se + jnp.sum(test * (pred - v) ** 2),
+                            nt + test.sum()), None
+
+                (se, nt), _ = jax.lax.scan(body, (0.0, 0.0), slabs)
+                return se, nt, jnp.zeros(()), jnp.zeros(()), jnp.zeros(())
+        else:
+            query_num, prec_k, threshold = rank_spec
+            qn_eff = min(query_num, n_items)
+            cut = min(prec_k, qn_eff)
+
+            def one(Ub, Vb, fold):
+                def body(carry, sl):
+                    u, i, v, f = sl
+                    uvec = Ub[u]                        # [C, K]
+                    scores = uvec @ Vb.T                # [C, n_items]
+                    s_i = jnp.sum(uvec * Vb[i], axis=1)
+                    # rank = #items scoring strictly higher; the held-out
+                    # item is in the served top-m iff rank < m
+                    rk = jnp.sum(scores > s_i[:, None], axis=1)
+                    test = (f == fold)
+                    pred = jnp.where(rk < qn_eff, s_i, 0.0)
+                    qual = test & (v >= threshold)
+                    # a user with NO training ratings solves to an exactly
+                    # zero factor row (gram=0, rhs=0), which would rank
+                    # its held-out item 0 (nothing beats an all-zero
+                    # score row) — but the sequential path serves an
+                    # unknown user an EMPTY list, i.e. a miss. Mask those
+                    # cold users out of the hit count to match.
+                    known = jnp.any(uvec != 0, axis=1)
+                    hit = qual & known & (rk < cut)
+                    se, nt, hits, nq, tse = carry
+                    testf = test.astype(jnp.float32)
+                    return (se + jnp.sum(testf * (s_i - v) ** 2),
+                            nt + testf.sum(),
+                            hits + hit.sum().astype(jnp.float32),
+                            nq + qual.sum().astype(jnp.float32),
+                            tse + jnp.sum(testf * (pred - v) ** 2)), None
+
+                init = (0.0, 0.0, 0.0, 0.0, 0.0)
+                (se, nt, hits, nq, tse), _ = jax.lax.scan(body, init, slabs)
+                return se, nt, hits, nq, tse
+
+        return jax.vmap(one)(U, V, fold_c)
+
+    return jax.jit(metric_batch)
+
+
+# ---------------------------------------------------------------------------
+# The sweep runner
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CandidateResult:
+    """Pooled-over-folds metrics + cost attribution for one candidate."""
+
+    params: ALSParams
+    group: str
+    wall_s: float
+    heldout_rmse: float
+    n_test: int
+    precision: Optional[float] = None
+    n_qual: Optional[int] = None
+    topn_mse: Optional[float] = None
+
+    def to_json_dict(self) -> dict:
+        return {
+            "group": self.group,
+            "wallTimeS": round(self.wall_s, 4),
+            "heldoutRmse": self.heldout_rmse,
+            "nTest": self.n_test,
+            **({"precision": self.precision, "nQual": self.n_qual,
+                "topnMse": self.topn_mse}
+               if self.precision is not None else {}),
+        }
+
+
+@dataclasses.dataclass
+class SweepResult:
+    candidates: List[CandidateResult]
+    n_groups: int
+    batch_sizes: List[int]
+    mode: str
+
+
+def _local_shardings():
+    """(unit_sharding_fn, replicated_sharding) over the LOCAL devices:
+    unit arrays shard their leading [b] axis across devices (when b
+    divides evenly), broadcast data is placed replicated ONCE so launches
+    never re-transfer the padded-row layout. (None, None) on one device."""
+    import jax
+
+    devices = jax.local_devices()
+    if len(devices) <= 1:
+        return None, None
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(devices), axis_names=("cand",))
+
+    def unit_sharding(b: int):
+        if b % len(devices) != 0:
+            return None
+        return NamedSharding(mesh, P("cand"))
+
+    return unit_sharding, NamedSharding(mesh, P())
+
+
+def run_sweep(data: ALSSweepData, candidates: Sequence[ALSParams], *,
+              rank_metrics: Optional[Tuple[int, int, float]] = None,
+              batched: bool = True, warm_start: bool = False,
+              registry=None) -> SweepResult:
+    """Evaluate every candidate over every fold as a few device launches.
+
+    ``rank_metrics`` — optional (query_num, precision_k, threshold) to
+    additionally compute full-catalog precision@k / top-N MSE (costs a
+    [units, chunk, n_items] score pass; held-out RMSE alone only gathers
+    the held-out entries). ``batched=False`` runs the identical kernels
+    one (candidate, fold) unit at a time — the sequential reference the
+    parity tests compare against. ``warm_start=True`` initializes each
+    rank group's item factors from the previous (smaller-rank) group's
+    trained factors of the same fold, column-padded with fresh noise —
+    an accuracy/speed knob that intentionally departs from seeded-init
+    parity, so it is off by default.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not candidates:
+        raise ValueError("candidates must not be empty")
+    if data.k_folds < 1:
+        raise ValueError("sweep data has no folds (empty rating set?)")
+    registry = registry or default_registry()
+    mode = "batched" if batched else "sequential"
+    k_folds = data.k_folds
+    groups = group_candidates(candidates)
+    group_items = list(groups.items())
+    if warm_start:
+        group_items.sort(key=lambda kv: kv[0].rank)
+
+    # multi-process: split compile groups round-robin; merge small score
+    # dicts at the end over the existing allgather protocol
+    n_proc = jax.process_count()
+    my_groups = [
+        (gi, key, members) for gi, (key, members) in enumerate(group_items)
+        if gi % n_proc == jax.process_index()]
+
+    # entries padded to a chunk multiple; fold -1 never matches a fold
+    n_pad = -(-max(data.nnz, 1) // 64) * 64
+    pad = n_pad - data.nnz
+    u_idx = np.concatenate([data.user_idx, np.zeros(pad, np.int32)])
+    i_idx = np.concatenate([data.item_idx, np.zeros(pad, np.int32)])
+    vals = np.concatenate([data.ratings, np.zeros(pad, np.float32)])
+    fold_e = np.concatenate([data.fold_of, np.full(pad, -1, np.int32)])
+
+    bu, bi = data.by_user, data.by_item
+    unit_sharding, rep_sh = _local_shardings()
+    with span("eval_data_put", registry):
+        def _put(a):
+            return (jax.device_put(a, rep_sh) if rep_sh is not None
+                    else jnp.asarray(a))
+
+        data_args = tuple(_put(a) for a in (
+            bu.tgt, bu.seg, bu.val, bu.w, bu.fold,
+            bi.tgt, bi.seg, bi.val, bi.w, bi.fold))
+        entry_args = tuple(_put(a) for a in
+                           (u_idx, i_idx, vals, fold_e))
+
+    batch_max = int(os.environ.get(BATCH_MAX_ENV, _DEFAULT_BATCH_MAX))
+    results: Dict[int, CandidateResult] = {}
+    batch_sizes: List[int] = []
+    prev_v: Dict[int, np.ndarray] = {}      # fold -> trained V (warm start)
+    shapes = (bu.tgt.shape[0], bi.tgt.shape[0], bu.row_len)
+
+    for gi, static, members in my_groups:
+        group_label = f"g{gi}:{static.label}"
+        units = [(ci, f) for ci in members for f in range(k_folds)]
+        b = min(len(units), batch_max) if batched else 1
+        max_iters = max(int(candidates[ci].num_iterations)
+                        for ci in members)
+        use_v0 = warm_start
+        train_key = (static, max_iters, b, data.n_users, data.n_items,
+                     shapes, use_v0)
+        train_fn = shape_cached_fn(
+            TRAIN_FAMILY, train_key,
+            lambda: _build_train_fn(static, data.n_users, data.n_items,
+                                    max_iters, b, shapes, use_v0))
+        metric_key = (static.rank, data.n_items, n_pad, b, rank_metrics,
+                      data.n_users)
+        metric_fn = shape_cached_fn(
+            METRIC_FAMILY, metric_key,
+            lambda: _build_metric_fn(static.rank, data.n_items, n_pad, b,
+                                     rank_metrics))
+        unit_sh = unit_sharding(b) if unit_sharding is not None else None
+
+        # raw pooled sums per candidate of this group
+        sums = {ci: np.zeros(5, np.float64) for ci in members}
+        t_group = time.perf_counter()
+        for lo in range(0, len(units), b):
+            launch = units[lo:lo + b]
+            n_real = len(launch)
+            launch = launch + [launch[0]] * (b - n_real)    # pad, discard
+            fold_c = np.asarray([f for _, f in launch], np.int32)
+            reg_c = np.asarray([candidates[ci].reg for ci, _ in launch],
+                               np.float32)
+            alpha_c = np.asarray([candidates[ci].alpha
+                                  for ci, _ in launch], np.float32)
+            iters_c = np.asarray([candidates[ci].num_iterations
+                                  for ci, _ in launch], np.int32)
+            if use_v0:
+                init_c = np.stack([
+                    _warm_init(prev_v.get(f), static.rank, data.n_items,
+                               int(candidates[ci].seed), f)
+                    for ci, f in launch])
+            else:
+                init_c = np.asarray([candidates[ci].seed
+                                     for ci, _ in launch], np.int32)
+            cand_args = (fold_c, reg_c, alpha_c, iters_c, init_c)
+            if unit_sh is not None:
+                cand_args = tuple(jax.device_put(a, unit_sh)
+                                  for a in cand_args)
+            with span("eval_train_group", registry):
+                U, V = train_fn(*data_args, *cand_args)
+                jax.block_until_ready(V)
+            batch_sizes.append(n_real)
+            eval_batch_size(registry).observe(n_real)
+            with span("eval_metrics", registry):
+                out = metric_fn(U, V, *entry_args,
+                                cand_args[0])        # fold_c as placed
+                out = np.asarray(jax.device_get(out), np.float64).T
+            for j, (ci, _f) in enumerate(launch[:n_real]):
+                sums[ci] += out[j]
+            if warm_start:
+                with span("eval_gather", registry):
+                    v_host = np.asarray(jax.device_get(V))
+                for j, (_ci, f) in enumerate(launch[:n_real]):
+                    prev_v[f] = v_host[j]         # latest group wins
+        group_wall = time.perf_counter() - t_group
+
+        for ci in members:
+            se, nt, hits, nq, tse = sums[ci]
+            res = CandidateResult(
+                params=candidates[ci], group=group_label,
+                wall_s=group_wall / len(members),
+                heldout_rmse=float(np.sqrt(se / nt)) if nt else float("nan"),
+                n_test=int(nt))
+            if rank_metrics is not None:
+                qn, pk, _thr = rank_metrics
+                denom = min(pk, min(qn, data.n_items))
+                res.precision = (float(hits / (denom * nq)) if nq
+                                 else float("nan"))
+                res.n_qual = int(nq)
+                res.topn_mse = (float(tse / nt) if nt else float("nan"))
+            results[ci] = res
+
+    if n_proc > 1:
+        from predictionio_tpu.parallel.shuffle import allgather_object
+
+        merged = {}
+        for part in allgather_object(
+                [(ci, dataclasses.asdict(r)) for ci, r in results.items()]):
+            for ci, d in part:
+                d["params"] = candidates[ci]
+                merged[ci] = CandidateResult(**d)
+        results = merged
+
+    missing = [i for i in range(len(candidates)) if i not in results]
+    assert not missing, f"sweep lost candidates {missing}"
+    eval_candidates_counter(registry).inc(len(candidates), mode=mode)
+    eval_compile_groups(registry).set(len(group_items))
+    return SweepResult(
+        candidates=[results[i] for i in range(len(candidates))],
+        n_groups=len(group_items), batch_sizes=batch_sizes, mode=mode)
+
+
+def _warm_init(v_prev: Optional[np.ndarray], rank: int, n_items: int,
+               seed: int, fold: int) -> np.ndarray:
+    """V0 for a warm-started unit: previous group's fold factors in the
+    leading columns, fresh scaled noise in the rest (or everywhere when
+    no previous group trained this fold)."""
+    rng = np.random.default_rng(seed * 1009 + fold)
+    v0 = (rng.standard_normal((n_items, rank)).astype(np.float32)
+          / np.sqrt(rank))
+    if v_prev is not None:
+        keep = min(rank, v_prev.shape[1])
+        v0[:, :keep] = v_prev[:n_items, :keep]
+    return v0

@@ -47,8 +47,18 @@ def run_evaluation(evaluation: Evaluation,
     instance.id = instance_id
     logger.info("EvaluationInstance %s created (INIT)", instance_id)
 
-    with workflow_run_metrics("evaluate", "pio_eval"):
-        result = evaluation.run(ctx, engine_params_list)
+    try:
+        with workflow_run_metrics("evaluate", "pio_eval"):
+            result = evaluation.run(ctx, engine_params_list)
+    except Exception as e:
+        # a failed sweep must not leave the instance stuck at INIT — the
+        # dashboard/admin listings would show it as forever-starting
+        instance.status = "EVALFAILED"
+        instance.end_time = _dt.datetime.now(tz=UTC)
+        instance.evaluator_results = f"{type(e).__name__}: {e}"
+        instances.update(instance)
+        logger.exception("evaluation failed: instance %s", instance_id)
+        raise
 
     instance.status = "EVALCOMPLETED"
     instance.end_time = _dt.datetime.now(tz=UTC)

@@ -8,10 +8,27 @@ worker (the r03 failure mode: rc=124, no line, no diagnostics).
 
 import json
 import os
+import re
 import subprocess
 import sys
 
 BENCH = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+
+#: configs whose judged shape is too heavy to re-run inside tier-1, with
+#: the reason on record. Every OTHER config MUST have a `_run(...)` smoke
+#: below — test_every_bench_config_has_smoke enforces it, so a future
+#: config cannot ship unsmoked without an explicit entry here.
+HEAVY_EXEMPT = {
+    "als_ml100k": "pure ALS kernel, ~60s of train even shrunk; the same "
+                  "kernel is driven by the eval_sweep_grid smoke",
+    "pipeline_ml100k": "full store->train->deploy->HTTP pipeline, minutes "
+                       "on CPU; covered piecewise by the e2e test suite",
+    "cooccurrence_ml1m": "1M-pair incidence build dominates at any scale",
+    "ecommerce_implicit_als": "full implicit ALS train; the implicit "
+                              "kernel is unit-tested in test_als",
+    "als_ml20m": "north-star scale; even the CPU-scaled variant is "
+                 "minutes of numpy baseline + train",
+}
 
 
 def _run(only: str, deadline: str, timeout: int, tmp_path, extra_env=None):
@@ -121,6 +138,65 @@ def test_bench_train_ingest_smoke(tmp_path):
     # the columnar path must actually beat the per-event fold, even at
     # smoke scale (the judged 100k sweep asserts nothing weaker)
     assert detail["speedup_headline"] > 1.0, detail
+
+
+def test_bench_eval_sweep_grid_smoke(tmp_path):
+    """Smoke the eval_sweep_grid config at a shrunken grid: the config
+    itself asserts the compile ledger equals the number of distinct
+    ranks AND that the batched and sequential paths pick the same best
+    candidate; the emitted detail must carry the candidates/sec and
+    compile-group fields the judged run records."""
+    p = _run("eval_sweep_grid", "300", timeout=280, tmp_path=tmp_path,
+             extra_env={"BENCH_EVAL_USERS": "150",
+                        "BENCH_EVAL_ITEMS": "100",
+                        "BENCH_EVAL_NNZ": "6000",
+                        "BENCH_EVAL_FOLDS": "2",
+                        "BENCH_EVAL_ITERS": "3",
+                        "BENCH_EVAL_RANKS": "4,6",
+                        "BENCH_EVAL_REGS": "0.01,0.1"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert "eval_sweep_grid" in out["unit"]
+    detail = next(d for d in
+                  json.load(open(tmp_path / "details.json"))["details"]
+                  if d["name"] == "eval_sweep_grid")
+    for key in ("candidates_per_s_batched", "candidates_per_s_sequential",
+                "speedup_batched_vs_sequential", "compile_groups",
+                "distinct_ranks", "max_rmse_diff_vs_sequential",
+                "grid_candidates"):
+        assert key in detail, (key, detail)
+    # the tentpole contract, visible in the judged artifact: the compile
+    # ledger is bounded by distinct ranks, not the 4-candidate grid
+    assert detail["compile_groups"] == detail["distinct_ranks"] == 2
+    assert detail["grid_candidates"] == 4
+    assert detail["max_rmse_diff_vs_sequential"] < 1e-4
+    assert detail["candidates_per_s_batched"] > 0
+
+
+def test_every_bench_config_has_smoke():
+    """Static gate: every bench.py config must either have a `_run(...)`
+    smoke in this file or a justified HEAVY_EXEMPT entry — future
+    configs cannot ship unsmoked."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_module", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    configs = {n for n in bench.CONFIGS if not n.startswith("_")}
+
+    with open(__file__) as f:
+        src = f.read()
+    smoked = set()
+    for arg in re.findall(r'_run\(\s*"([^"]+)"', src):
+        smoked.update(n for n in arg.split(",") if not n.startswith("_"))
+    unknown = (smoked | set(HEAVY_EXEMPT)) - configs
+    assert not unknown, f"smoke/exempt entries for unknown configs: {unknown}"
+    uncovered = configs - smoked - set(HEAVY_EXEMPT)
+    assert not uncovered, (
+        f"bench configs with neither a smoke test nor a HEAVY_EXEMPT "
+        f"entry: {sorted(uncovered)}")
 
 
 def test_bench_survives_wedged_worker_and_reports_partial(tmp_path):

@@ -1,0 +1,413 @@
+"""Device-batched evaluation sweep: parity, compile ledger, failure paths.
+
+The tentpole contract under test:
+  * the batched (vmapped) sweep matches the sequential per-candidate
+    execution of the SAME kernels to 1e-5 per candidate, and the
+    engine-level vectorized evaluator picks the same best EngineParams
+    as the pre-existing DASE sequential loop;
+  * the XLA compile ledger of a sweep equals the number of distinct
+    ranks, not the grid size;
+  * fold splitting is vectorized and rejects k > n;
+  * a failing evaluation persists EVALFAILED (not a stuck INIT) and the
+    per-candidate wall-time/compile-group breakdown lands in
+    evaluator_results_json.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import Engine, EngineParams, MetricEvaluator
+from predictionio_tpu.core.cross_validation import (
+    fold_assignments, fold_masks, k_fold, split_data,
+)
+from predictionio_tpu.core.evaluation import Evaluation, expand_param_grid
+from predictionio_tpu.engines.recommendation import (
+    ALSAlgorithm, AlgorithmParams, DataSourceParams, PrecisionAtK,
+    RatingColumns, RecommendationDataSource, RecommendationPreparator,
+    RecommendationServing, RMSEMetric,
+)
+from predictionio_tpu.models.als import ALSParams
+from predictionio_tpu.models.als_sweep import build_sweep_data, run_sweep
+
+
+class Ctx:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# split_data vectorization + validation
+# ---------------------------------------------------------------------------
+
+def test_split_data_rejects_k_above_n():
+    with pytest.raises(ValueError, match="exceeds"):
+        list(split_data(5, 3))
+    with pytest.raises(ValueError, match="exceeds"):
+        list(k_fold([1, 2], 3))
+    with pytest.raises(ValueError):
+        fold_assignments(4, 2)
+
+
+def test_split_data_still_rejects_k_below_one():
+    with pytest.raises(ValueError, match=">= 1"):
+        list(split_data(0, 10))
+
+
+def test_fold_masks_match_split_data():
+    k, n = 4, 21
+    masks = fold_masks(k, n)
+    assert masks.shape == (k, n)
+    # every point is in exactly one test fold
+    assert (masks.sum(axis=0) == 1).all()
+    for fold, (train, test) in enumerate(split_data(k, n)):
+        assert np.array_equal(np.flatnonzero(masks[fold]), test)
+        assert np.array_equal(np.flatnonzero(~masks[fold]), train)
+
+
+def test_fold_assignments_is_index_mod_k():
+    assert np.array_equal(fold_assignments(3, 7),
+                          np.asarray([0, 1, 2, 0, 1, 2, 0]))
+
+
+# ---------------------------------------------------------------------------
+# Kernel-level parity: batched vmap vs sequential execution
+# ---------------------------------------------------------------------------
+
+def _synthetic(nu, ni, nnz, seed=0):
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, nu, nnz).astype(np.int32)
+    items = rng.integers(0, ni, nnz).astype(np.int32)
+    lu, lv = rng.normal(size=(nu, 3)), rng.normal(size=(ni, 3))
+    ratings = np.clip(np.round(
+        2.5 + np.einsum("nk,nk->n", lu[users], lv[items])), 1, 5
+    ).astype(np.float32)
+    return users, items, ratings
+
+
+def test_batched_sweep_matches_sequential_kernel_to_1e5():
+    nu, ni, nnz, k = 50, 30, 1500, 3
+    users, items, ratings = _synthetic(nu, ni, nnz, seed=1)
+    fold_of = fold_assignments(k, nnz)
+    data = build_sweep_data(users, items, ratings, fold_of, nu, ni)
+    cands = [ALSParams(rank=r, num_iterations=3, reg=g, chunk_size=2048)
+             for r in (3, 5) for g in (0.02, 0.2)]
+    batched = run_sweep(data, cands, rank_metrics=(5, 4, 2.0))
+    sequential = run_sweep(data, cands, rank_metrics=(5, 4, 2.0),
+                           batched=False)
+    assert batched.mode == "batched" and sequential.mode == "sequential"
+    assert batched.n_groups == 2        # two distinct ranks
+    denom = min(4, min(5, ni))
+    for cb, cs in zip(batched.candidates, sequential.candidates):
+        # the continuous metric matches to 1e-5; the rank-QUANTIZED
+        # metrics may flip a single near-tied top-k edge (vmap reorders
+        # float reductions at ~1e-7, and a tie within that noise moves a
+        # whole 1/denom precision point), so they are asserted as
+        # at-most-one-flipped-hit instead
+        assert cb.heldout_rmse == pytest.approx(cs.heldout_rmse, abs=1e-5)
+        hits_b = round(cb.precision * denom * cb.n_qual)
+        hits_s = round(cs.precision * denom * cs.n_qual)
+        assert abs(hits_b - hits_s) <= max(1, cb.n_qual // 100), \
+            (hits_b, hits_s, cb.n_qual)
+        assert cb.topn_mse == pytest.approx(cs.topn_mse, abs=0.05)
+        assert cb.n_test == cs.n_test and cb.n_qual == cs.n_qual
+    # best candidate identical
+    best_b = min(range(len(cands)),
+                 key=lambda i: batched.candidates[i].heldout_rmse)
+    best_s = min(range(len(cands)),
+                 key=lambda i: sequential.candidates[i].heldout_rmse)
+    assert best_b == best_s
+
+
+def test_sweep_pools_folds_and_attributes_cost():
+    nu, ni, nnz, k = 31, 17, 800, 2
+    users, items, ratings = _synthetic(nu, ni, nnz, seed=2)
+    data = build_sweep_data(users, items, ratings,
+                            fold_assignments(k, nnz), nu, ni)
+    cands = [ALSParams(rank=4, num_iterations=2, reg=g) for g in (0.1, 0.3)]
+    res = run_sweep(data, cands)
+    assert len(res.candidates) == 2
+    for c in res.candidates:
+        assert np.isfinite(c.heldout_rmse)
+        # pooled over BOTH folds: every rating is a test point exactly once
+        assert c.n_test == nnz
+        assert c.wall_s > 0
+        assert c.group.endswith("rank=4")
+    assert res.batch_sizes == [4]       # 2 candidates x 2 folds, one launch
+
+
+def test_warm_start_runs_and_converges_no_worse():
+    nu, ni, nnz, k = 40, 22, 1200, 2
+    users, items, ratings = _synthetic(nu, ni, nnz, seed=3)
+    data = build_sweep_data(users, items, ratings,
+                            fold_assignments(k, nnz), nu, ni)
+    cands = [ALSParams(rank=r, num_iterations=4, reg=0.1) for r in (3, 5)]
+    cold = run_sweep(data, cands)
+    warm = run_sweep(data, cands, warm_start=True)
+    for cc, cw in zip(cold.candidates, warm.candidates):
+        assert np.isfinite(cw.heldout_rmse)
+        # warm start is an accuracy knob, not a parity mode: just bound
+        # it against catastrophics
+        assert cw.heldout_rmse < cc.heldout_rmse * 1.5 + 1.0
+
+
+def test_cold_users_are_misses_not_free_hits():
+    """A user whose EVERY rating lands in the test fold trains to an
+    exactly-zero factor row; an all-zero score row would rank its
+    held-out item 0 (a guaranteed 'hit'). The sequential path serves
+    unknown users an empty list — a miss — so the device kernel must
+    mask cold users out of the hit count."""
+    # 10 users, ONE rating each, k=2: every test entry's user is cold in
+    # its own fold, so precision must be exactly 0, never ~1
+    n = 10
+    users = np.arange(n, dtype=np.int32)
+    items = (np.arange(n, dtype=np.int32) % 4)
+    ratings = np.full(n, 5.0, np.float32)        # all qualify
+    data = build_sweep_data(users, items, ratings,
+                            fold_assignments(2, n), n, 4)
+    res = run_sweep(data, [ALSParams(rank=2, num_iterations=2, reg=0.1)],
+                    rank_metrics=(3, 3, 2.0))
+    c = res.candidates[0]
+    assert c.n_qual == n
+    assert c.precision == 0.0
+
+
+def test_sweep_kind_not_inherited_past_custom_math():
+    """A metric subclass that customizes calculate_point without
+    re-declaring sweep_kind must NOT silently get the stock device
+    kernel — the evaluator falls back to its (customized) sequential
+    math."""
+    from predictionio_tpu.core.evaluation import sweep_kind_of
+
+    class InheritedPrecision(PrecisionAtK):       # custom math, no kind
+        def calculate_point(self, eval_info, q, p, a):
+            return 1.0
+
+    class RedeclaredPrecision(InheritedPrecision):  # explicit opt back in
+        sweep_kind = "precision_at_k"
+
+    assert sweep_kind_of(PrecisionAtK()) == "precision_at_k"
+    assert sweep_kind_of(InheritedPrecision()) is None
+    assert sweep_kind_of(RedeclaredPrecision()) == "precision_at_k"
+
+    engine = _mem_engine(seed=19)
+    result = MetricEvaluator(InheritedPrecision(k=3), output_path=None) \
+        .evaluate(Ctx(), engine, _grid_eps(ranks=(3,), regs=(0.1,)))
+    assert result.sweep["mode"] == "sequential"
+    assert result.best_score == 1.0               # the override ran
+
+
+def test_mixed_iterations_share_a_compile_group():
+    """num_iterations is shape-preserving: candidates differing only in
+    iteration count ride ONE compile group (traced per-unit trip count),
+    and fewer iterations means a genuinely different result."""
+    nu, ni, nnz, k = 23, 13, 500, 2
+    users, items, ratings = _synthetic(nu, ni, nnz, seed=4)
+    data = build_sweep_data(users, items, ratings,
+                            fold_assignments(k, nnz), nu, ni)
+    cands = [ALSParams(rank=4, num_iterations=it, reg=0.1) for it in (1, 4)]
+    res = run_sweep(data, cands)
+    assert res.n_groups == 1
+    assert res.candidates[0].heldout_rmse != pytest.approx(
+        res.candidates[1].heldout_rmse, abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Compile ledger: pio_jax_compile_total delta == distinct ranks
+# ---------------------------------------------------------------------------
+
+def _compile_total(family):
+    from predictionio_tpu.obs.jax_stats import compile_counter
+
+    for labels, value in compile_counter().samples():
+        if labels.get("family") == family:
+            return value
+    return 0.0
+
+
+def test_compile_ledger_counts_ranks_not_grid_size():
+    # unique data dims so this test's cache keys cannot collide with
+    # other tests' (fn_cache dedups sightings per key)
+    nu, ni, nnz, k = 37, 19, 700, 2
+    users, items, ratings = _synthetic(nu, ni, nnz, seed=5)
+    data = build_sweep_data(users, items, ratings,
+                            fold_assignments(k, nnz), nu, ni)
+    # 8 candidates, only TWO distinct ranks
+    cands = [ALSParams(rank=r, num_iterations=2, reg=g, seed=s)
+             for r in (3, 4) for g in (0.05, 0.5) for s in (1, 2)]
+    before = _compile_total("als_eval_sweep")
+    res = run_sweep(data, cands)
+    delta = _compile_total("als_eval_sweep") - before
+    assert delta == 2 == res.n_groups, (
+        f"compile ledger grew by {delta} for 2 distinct ranks "
+        f"({len(cands)} candidates)")
+    # re-running the identical sweep compiles NOTHING new
+    run_sweep(data, cands)
+    assert _compile_total("als_eval_sweep") - before == 2
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: vectorized evaluator vs the DASE sequential loop
+# ---------------------------------------------------------------------------
+
+def _mem_engine(nu=40, ni=24, per_user=10, seed=7):
+    """Recommendation engine over an in-memory rating set (no storage)."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for u in range(nu):
+        for i in rng.choice(ni, size=per_user, replace=False):
+            rows.append((f"u{u:03d}", f"i{i:03d}",
+                         float(rng.integers(1, 6))))
+    users = np.asarray([r[0] for r in rows], dtype=object)
+    items = np.asarray([r[1] for r in rows], dtype=object)
+    vals = np.asarray([r[2] for r in rows], dtype=np.float32)
+
+    class MemDS(RecommendationDataSource):
+        def _read_columns(self):
+            return RatingColumns(users=users, items=items, values=vals)
+
+    return Engine(MemDS, RecommendationPreparator, {"als": ALSAlgorithm},
+                  RecommendationServing)
+
+
+def _grid_eps(ranks=(3, 5), regs=(0.05, 0.3), k_fold=2, query_num=4,
+              iters=2):
+    return [EngineParams(
+        data_source_params=DataSourceParams(
+            app_name="mem",
+            eval_params={"kFold": k_fold, "queryNum": query_num}),
+        algorithm_params_list=[("als", AlgorithmParams(
+            rank=r, num_iterations=iters, reg=g))])
+        for r in ranks for g in regs]
+
+
+def test_evaluator_vectorized_selects_same_best(monkeypatch):
+    engine = _mem_engine()
+    eps = _grid_eps()
+    evaluator = MetricEvaluator(PrecisionAtK(k=3), output_path=None)
+    batched = evaluator.evaluate(Ctx(), engine, eps)
+    monkeypatch.setenv("PIO_EVAL_VECTORIZE", "0")
+    sequential = evaluator.evaluate(Ctx(), engine, eps)
+    assert batched.sweep["mode"] == "batched"
+    assert sequential.sweep["mode"] == "sequential"
+    assert batched.sweep["compileGroups"] == 2
+    # same winner; scores agree to tie-flip tolerance (the sequential
+    # DASE path trains on per-fold subset BUILDS, the batched path on a
+    # fold-masked shared layout — identical math, different float
+    # summation boundaries, so near-tied top-k edges can flip a handful
+    # of quantized precision points)
+    assert batched.best_idx == sequential.best_idx
+    for (_, sb, _o1), (_, ss, _o2) in zip(
+            batched.engine_params_scores,
+            sequential.engine_params_scores):
+        assert sb == pytest.approx(ss, abs=5e-3)
+    # per-candidate breakdown present on both paths
+    assert len(batched.candidate_details) == len(eps)
+    assert batched.candidate_details[0]["group"].startswith("g")
+    assert sequential.candidate_details[0]["group"] == "sequential"
+    assert all(d["wallTimeS"] >= 0 for d in batched.candidate_details)
+    js = json.loads(json.dumps(batched.to_json_dict()))
+    assert js["sweep"]["mode"] == "batched"
+    assert len(js["candidates"]) == len(eps)
+
+
+def test_evaluator_vectorized_other_metrics_device_computed():
+    engine = _mem_engine(seed=11)
+    eps = _grid_eps(ranks=(3,), regs=(0.05, 0.5))
+    evaluator = MetricEvaluator(PrecisionAtK(k=3),
+                                other_metrics=[RMSEMetric()],
+                                output_path=None)
+    result = evaluator.evaluate(Ctx(), engine, eps)
+    assert result.sweep["mode"] == "batched"
+    for _ep, _score, others in result.engine_params_scores:
+        assert len(others) == 1 and np.isfinite(others[0])
+
+
+def test_evaluator_falls_back_without_sweep_support():
+    """Metrics without a sweep_kind keep the sequential loop."""
+    class HostOnlyPrecision(PrecisionAtK):
+        sweep_kind = None
+
+    engine = _mem_engine(seed=13)
+    eps = _grid_eps(ranks=(3,), regs=(0.1,))
+    result = MetricEvaluator(HostOnlyPrecision(k=3),
+                             output_path=None).evaluate(Ctx(), engine, eps)
+    assert result.sweep["mode"] == "sequential"
+    assert result.candidate_details[0]["group"] == "sequential"
+
+
+def test_expand_param_grid_cross_product():
+    base = _grid_eps(ranks=(3,), regs=(0.1,))
+    out = expand_param_grid(base, ["rank=4,6", "reg=0.01,0.1,0.5"])
+    assert len(out) == 6
+    combos = {(ep.algorithm_params_list[0][1].rank,
+               ep.algorithm_params_list[0][1].reg) for ep in out}
+    assert combos == {(4, 0.01), (4, 0.1), (4, 0.5),
+                      (6, 0.01), (6, 0.1), (6, 0.5)}
+    # shared non-algo params survive
+    assert all(ep.data_source_params.eval_params["kFold"] == 2
+               for ep in out)
+    with pytest.raises(ValueError, match="not a parameter"):
+        expand_param_grid(base, ["nope=1,2"])
+    with pytest.raises(ValueError, match="expected"):
+        expand_param_grid(base, ["rank"])
+    with pytest.raises(ValueError, match="twice"):
+        expand_param_grid(base, ["rank=8,12", "rank=16,24"])
+    assert expand_param_grid(base, []) == base
+
+
+# ---------------------------------------------------------------------------
+# Workflow persistence: EVALFAILED + per-candidate JSON
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def meta(tmp_path):
+    from predictionio_tpu.storage import Storage
+
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite",
+                           "PATH": str(tmp_path / "eval.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    yield Storage
+    Storage.reset()
+
+
+def test_failed_evaluation_persists_evalfailed(meta):
+    from predictionio_tpu.workflow import run_evaluation
+
+    class BoomEvaluation(Evaluation):
+        def run(self, ctx, engine_params_list):
+            raise RuntimeError("sweep exploded")
+
+    with pytest.raises(RuntimeError, match="sweep exploded"):
+        run_evaluation(BoomEvaluation(), _grid_eps(ranks=(3,), regs=(0.1,)),
+                       evaluation_class="BoomEvaluation")
+    stored = meta.get_meta_data_evaluation_instances().get_all()
+    assert len(stored) == 1
+    assert stored[0].status == "EVALFAILED"
+    assert "RuntimeError: sweep exploded" in stored[0].evaluator_results
+
+
+def test_evaluation_persists_candidate_breakdown(meta):
+    from predictionio_tpu.workflow import run_evaluation
+
+    engine = _mem_engine(seed=17)
+    eps = _grid_eps(ranks=(3, 4), regs=(0.1,))
+    ev = Evaluation(engine=engine, metric=PrecisionAtK(k=3),
+                    output_path=None)
+    run_evaluation(ev, eps, evaluation_class="MemEval")
+    stored = meta.get_meta_data_evaluation_instances().get_completed()
+    assert len(stored) == 1
+    js = json.loads(stored[0].evaluator_results_json)
+    assert len(js["candidates"]) == len(eps)
+    for cand in js["candidates"]:
+        assert cand["wallTimeS"] >= 0
+        assert "group" in cand
+    assert js["sweep"]["mode"] == "batched"
+    assert js["sweep"]["compileGroups"] == 2

@@ -52,6 +52,9 @@ Configs (order = bank cheap+judged numbers first, riskiest last):
   deploy_swap       deploy lifecycle cutover: cold reload vs warm swap
                     first-traffic latency + post-swap compile counts
                     (warm must be ZERO — the deploy/ acceptance bar)
+  ingest_write      event WRITE hot path: per-request inserts vs the
+                    group-commit WriteBuffer on sqlite + parquet,
+                    events/s + ack p99 (asserts >=5x and exactly-once)
   als_ml20m         MovieLens-20M ALS on one chip: 20M ratings,
                     138k x 27k, string-id assignment + data build +
                     train + RMSE all timed (north star, BASELINE.md)
@@ -1374,6 +1377,169 @@ def cfg_train_ingest(jax, mesh, platform):
     return detail
 
 
+def cfg_ingest_write(jax, mesh, platform):
+    """Event WRITE hot path: the per-request insert (one storage
+    transaction per HTTP request — the pre-PR6 event server) vs the
+    group-commit WriteBuffer (data/write_buffer.py: bounded queue +
+    dedicated writer coalescing concurrent submits into few insert_batch
+    flushes), on sqlite and parquet. Per-request drives C concurrent
+    client threads (the aiohttp executor shape); grouped drives an
+    open-loop submitter with a bounded outstanding window (the event
+    loop + per-request futures shape) and measures ack latency
+    submit->resolve. Asserts the tentpole bar: grouped sustains >=
+    BENCH_INGEST_WRITE_MIN_SPEEDUP x the per-request events/s (default
+    5) with bounded ack p99, and zero loss/duplication at bench scale
+    (row count == submissions). No device math — this is the storage-SPI
+    analog of what the reference delegated to HBase/ES."""
+    import datetime as dt
+    import shutil
+    import tempfile
+    import threading
+
+    from predictionio_tpu.data.event import Event, UTC
+    from predictionio_tpu.data.write_buffer import WriteBuffer
+    from predictionio_tpu.obs.registry import MetricsRegistry
+
+    n_grouped = int(os.environ.get("BENCH_INGEST_WRITE_EVENTS", 24576))
+    clients = int(os.environ.get("BENCH_INGEST_WRITE_CLIENTS", 16))
+    backends = os.environ.get(
+        "BENCH_INGEST_WRITE_BACKENDS", "sqlite,parquet").split(",")
+    min_speedup = float(os.environ.get("BENCH_INGEST_WRITE_MIN_SPEEDUP", 5))
+    p99_bound_ms = float(os.environ.get("BENCH_INGEST_WRITE_P99_MS", 2000))
+    detail = {"clients": clients, "events_grouped": n_grouped,
+              "min_speedup": min_speedup}
+    total_t0 = time.perf_counter()
+    APP = 7
+
+    def build_events(n, seed_off=0):
+        base = dt.datetime(2026, 1, 1, tzinfo=UTC)
+        return [Event(
+            event="view", entity_type="user",
+            entity_id=f"u{(seed_off + i) % 5000}",
+            target_entity_type="item", target_entity_id=f"i{i % 800}",
+            event_time=base + dt.timedelta(seconds=seed_off + i))
+            for i in range(n)]
+
+    def make_store(root, backend):
+        if backend == "parquet":
+            from predictionio_tpu.storage.parquet_events import (
+                ParquetEvents, ParquetEventsClient)
+            store = ParquetEvents(ParquetEventsClient(f"{root}/events"))
+        else:
+            from predictionio_tpu.storage.sqlite_backend import (
+                SqliteClient, SqliteEvents)
+            store = SqliteEvents(SqliteClient(f"{root}/events.db"))
+        store.init_channel(APP)
+        return store
+
+    def run_per_request(store, events):
+        """The old path: C concurrent requests, each one insert/txn."""
+        lat, lock = [], threading.Lock()
+        per = len(events) // clients
+
+        def client(c):
+            mine = []
+            for k in range(per):
+                t0 = time.perf_counter()
+                store.insert(events[c * per + k], APP)
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                lat.extend(mine)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat.sort()
+        return per * clients / wall, lat[int(0.99 * len(lat))] * 1000
+
+    def run_grouped(store, events, registry):
+        """The new path: open-loop submits with a bounded outstanding
+        window; ack latency is submit -> future resolved."""
+        buf = WriteBuffer(store_fn=lambda: store, flush_max=512,
+                          linger_s=0.002, queue_max=1 << 20,
+                          registry=registry)
+        outstanding = threading.Semaphore(1024)
+        lat, done, n = [], threading.Event(), len(events)
+        t0 = time.perf_counter()
+        for e in events:
+            outstanding.acquire()
+
+            def ack(_f, t_s=time.perf_counter()):
+                lat.append(time.perf_counter() - t_s)  # writer thread only
+                if len(lat) == n:
+                    done.set()
+                outstanding.release()
+
+            buf.submit([e], APP).add_done_callback(ack)
+        assert done.wait(600), "grouped ingest did not complete"
+        wall = time.perf_counter() - t0
+        buf.stop()
+        lat.sort()
+        return n / wall, lat[int(0.99 * len(lat))] * 1000
+
+    for backend in backends:
+        # per-request side needs far fewer events for a stable rate —
+        # and on parquet every one is a whole fragment file, which the
+        # exactly-once row-count scan must re-read
+        n_pr = max(clients, min(n_grouped // 8,
+                                768 if backend == "parquet" else 4096))
+        hb(f"ingest_write per-request {backend}")
+        root_pr = tempfile.mkdtemp(prefix="pio_bench_ingw_pr_")
+        root_g = tempfile.mkdtemp(prefix="pio_bench_ingw_g_")
+        try:
+            store = make_store(root_pr, backend)
+            eps_pr, p99_pr = max(
+                run_per_request(store, build_events(n_pr, i * n_pr))
+                for i in range(2))
+            # each round inserts per*clients (truncated division)
+            assert store.find_columnar(APP).num_rows \
+                == 2 * (n_pr // clients) * clients
+            hb(f"ingest_write grouped {backend}")
+            store_g = make_store(root_g, backend)
+            half = n_grouped // 2
+            reg = MetricsRegistry()
+            eps_g, p99_g = max(
+                run_grouped(store_g, build_events(half, i * half), reg)
+                for i in range(2))
+            # zero loss, zero duplication at bench scale
+            assert store_g.find_columnar(APP).num_rows == 2 * half, \
+                "grouped ingest lost or duplicated events"
+            flushes = reg.get("pio_ingest_flush_size")
+            speedup = eps_g / eps_pr
+            detail[f"events_per_s_per_request_{backend}"] = round(eps_pr)
+            detail[f"events_per_s_grouped_{backend}"] = round(eps_g)
+            detail[f"p99_ms_per_request_{backend}"] = round(p99_pr, 1)
+            detail[f"p99_ms_grouped_{backend}"] = round(p99_g, 1)
+            detail[f"speedup_{backend}"] = round(speedup, 2)
+            detail[f"mean_flush_{backend}"] = round(
+                flushes.total_sum() / max(1, flushes.total_count()), 1)
+            assert speedup >= min_speedup, (
+                f"group commit on {backend}: {speedup:.1f}x < "
+                f"{min_speedup}x over the per-request path")
+            assert p99_g <= p99_bound_ms, (
+                f"grouped ack p99 {p99_g:.0f}ms breaches the "
+                f"{p99_bound_ms:.0f}ms bound on {backend}")
+        finally:
+            shutil.rmtree(root_pr, ignore_errors=True)
+            shutil.rmtree(root_g, ignore_errors=True)
+    detail["elapsed_s"] = round(time.perf_counter() - total_t0, 2)
+    detail["speedup_headline"] = detail[f"speedup_{backends[0]}"]
+    detail["note"] = (
+        "group-commit ingest vs per-request writes: "
+        + "; ".join(
+            f"{b}: {detail[f'speedup_{b}']}x "
+            f"({detail[f'events_per_s_grouped_{b}']} vs "
+            f"{detail[f'events_per_s_per_request_{b}']} ev/s, "
+            f"ack p99 {detail[f'p99_ms_grouped_{b}']}ms)"
+            for b in backends))
+    return detail
+
+
 def cfg_sleep_forever(jax, mesh, platform):
     """Test-only config (never in the default set): wedges the worker so
     the orchestrator's watchdog + ladder can be exercised on CPU."""
@@ -1393,6 +1559,7 @@ CONFIGS = {
     "serving_batching": (cfg_serving_batching, 240),
     "deploy_swap": (cfg_deploy_swap, 240),
     "train_ingest": (cfg_train_ingest, 240),
+    "ingest_write": (cfg_ingest_write, 240),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
 

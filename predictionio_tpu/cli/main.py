@@ -729,6 +729,53 @@ def export_cmd(appid, appname, channel, output_path, fmt):
     click.echo(f"[INFO] Exported {n} events to {output_path}.")
 
 
+@cli.command("compact")
+@click.option("--appid", type=int, default=None)
+@click.option("--appname", default=None)
+@click.option("--channel", default=None)
+@click.option("--ttl-days", type=float, default=None,
+              help="Also drop events older than this many days "
+                   "(per-app retention sweep).")
+def compact_cmd(appid, appname, channel, ttl_days):
+    """Event-store maintenance: fold deletes, merge fragments, apply
+    retention. Crash-safe on parquet (write-new-then-remove-old behind an
+    atomically committed manifest); a retention DELETE on SQL backends.
+    Run one compactor per app namespace at a time."""
+    from predictionio_tpu.data.eventstore import resolve_app
+    from predictionio_tpu.storage import Storage, StorageError
+
+    if appname:
+        try:
+            app_id, channel_id = resolve_app(appname, channel)
+        except StorageError as e:
+            click.echo(f"[ERROR] {e}. Aborting.")
+            sys.exit(1)
+    elif appid is not None:
+        app_id, channel_id = appid, None
+        if channel is not None:
+            # compaction is destructive: never silently fall back to the
+            # default channel when the named one cannot be resolved
+            matched = [c for c in Storage.get_meta_data_channels()
+                       .get_by_appid(appid) if c.name == channel]
+            if not matched:
+                click.echo(f"[ERROR] app {appid} has no channel "
+                           f"'{channel}'. Aborting.")
+                sys.exit(1)
+            channel_id = matched[0].id
+    else:
+        click.echo("[ERROR] --appid or --appname is required.")
+        sys.exit(1)
+    store = Storage.get_events()
+    try:
+        stats = store.compact(app_id, channel_id, ttl_days=ttl_days)
+    except StorageError as e:
+        click.echo(f"[ERROR] compaction failed: {e}")
+        sys.exit(1)
+    click.echo(f"[INFO] Compacted app {app_id}"
+               + (f" channel {channel_id}" if channel_id is not None else "")
+               + ": " + json.dumps(stats, sort_keys=True))
+
+
 # ---------------------------------------------------------------------------
 # servers
 # ---------------------------------------------------------------------------

@@ -1,0 +1,341 @@
+"""Group-commit write buffer: the durable, self-defending ingest path.
+
+The reference delegated write-path survival to HBase/ES; the rebuild's own
+backends get there with an explicit pipeline stage between the REST handler
+and the EventStore (ROADMAP item 2). The shape mirrors the serving-side
+MicroBatcher (server/query_server.py): many small concurrent requests are
+coalesced into few large storage operations, with per-request futures so
+every HTTP caller still gets its own answer.
+
+Three mechanisms:
+
+* **group commit** — a dedicated writer thread drains the queue and folds
+  concurrent submits into single ``insert_batch`` flushes per
+  (app, channel) namespace, amortizing sqlite transactions, postgres
+  round-trips and parquet fragment creation. Flush triggers on size
+  (``flush_max`` events) or linger (``linger_s`` after the first event of
+  a batch), whichever comes first.
+* **backpressure** — the queue is bounded in EVENTS (``queue_max``).
+  ``submit`` never blocks and never queues unboundedly: past the bound it
+  raises :class:`BufferFull` carrying a ``retry_after`` estimate, which
+  the event server turns into ``429 Retry-After`` (explicit load
+  shedding instead of the silent executor-queue growth it replaces).
+* **fault tolerance** — every event is assigned its id at SUBMIT time, so
+  a flush is idempotent: retries (exponential backoff + decorrelated
+  jitter, bounded attempts) go through
+  ``EventStore.insert_batch_idempotent`` which skips ids already
+  persisted — a fault after the backend committed cannot duplicate, a
+  fault before it cannot lose (the request future fails only when every
+  attempt is exhausted). A flush that HANGS is bounded by
+  ``flush_timeout_s`` (the attempt runs on its own thread) and retried
+  the same way.
+
+``stop(drain=True)`` flushes everything still queued before returning —
+the aiohttp ``on_shutdown`` hook uses it so buffered events are never
+dropped by a graceful restart.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.storage.base import StorageError, generate_id
+
+logger = logging.getLogger("pio.writebuffer")
+
+
+class BufferFull(Exception):
+    """The bounded ingest queue cannot accept more events right now.
+
+    ``retry_after`` is a seconds estimate of when capacity should free up
+    (queue depth over the recently observed flush rate), for the
+    ``Retry-After`` response header.
+    """
+
+    def __init__(self, depth: int, retry_after: int):
+        super().__init__(
+            f"ingest queue full ({depth} events buffered); "
+            f"retry in ~{retry_after}s")
+        self.depth = depth
+        self.retry_after = retry_after
+
+
+def _as_storage_error(e: Exception) -> StorageError:
+    return e if isinstance(e, StorageError) else StorageError(repr(e))
+
+
+def _with_id(e: Event) -> Event:
+    """Copy of `e` with a fresh event_id. Shallow __dict__ clone instead of
+    dataclasses.replace: the source event already passed __post_init__
+    validation and replace() would re-run it — measurable at group-commit
+    submit rates (~20us/event saved on the ingest hot path)."""
+    clone = object.__new__(Event)
+    clone.__dict__.update(e.__dict__)
+    clone.__dict__["event_id"] = generate_id()
+    return clone
+
+
+class _Pending:
+    """One submit: its (already id-assigned) events and the caller future."""
+
+    __slots__ = ("events", "app_id", "channel_id", "future")
+
+    def __init__(self, events, app_id, channel_id, future):
+        self.events = events
+        self.app_id = app_id
+        self.channel_id = channel_id
+        self.future = future
+
+
+def _start_attempt(fn, args) -> "concurrent.futures.Future":
+    """Run one storage call on its own thread, returning its future.
+
+    A per-attempt thread (not a pool) so a hung backend call can never
+    wedge the slot the NEXT attempt needs; the daemon thread dies with
+    the backend call whenever it finally returns.
+    """
+    f: concurrent.futures.Future = concurrent.futures.Future()
+
+    def run():
+        try:
+            f.set_result(fn(*args))
+        except BaseException as e:  # noqa: BLE001 — relayed to the waiter
+            f.set_exception(e)
+
+    threading.Thread(target=run, daemon=True, name="pio-ingest-flush").start()
+    return f
+
+
+class WriteBuffer:
+    """Bounded group-commit buffer in front of an EventStore."""
+
+    def __init__(self, store_fn: Optional[Callable] = None, *,
+                 queue_max: int = 8192, flush_max: int = 256,
+                 linger_s: float = 0.002, retries: int = 4,
+                 backoff_s: float = 0.05, backoff_cap_s: float = 1.0,
+                 flush_timeout_s: float = 30.0, registry=None):
+        if store_fn is None:
+            from predictionio_tpu.storage.registry import Storage
+
+            store_fn = Storage.get_events
+        self._store_fn = store_fn
+        self.queue_max = max(1, queue_max)
+        self.flush_max = max(1, flush_max)
+        self.linger_s = max(0.0, linger_s)
+        self.retries = max(0, retries)
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.flush_timeout_s = flush_timeout_s
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque[_Pending] = deque()
+        self._depth = 0            # queued + in-flush events (memory bound)
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        self._last_flush_s = 0.05  # seeds the retry-after estimate
+
+        self._shed_total = self._retry_total = None
+        self._flush_size = self._flush_duration = None
+        if registry is not None:
+            registry.gauge_callback(
+                "pio_ingest_queue_depth",
+                "Events buffered for group commit (queued + in flush)",
+                lambda: float(self.queue_depth()))
+            self._shed_total = registry.counter(
+                "pio_ingest_shed_total",
+                "Events rejected with 429 because the ingest queue was full")
+            self._retry_total = registry.counter(
+                "pio_ingest_retry_total",
+                "Flush attempts retried after a storage fault or timeout")
+            self._flush_size = registry.histogram(
+                "pio_ingest_flush_size",
+                "Events per group-commit flush",
+                buckets=(1., 2., 4., 8., 16., 32., 64., 128., 256., 512.,
+                         1024.))
+            self._flush_duration = registry.histogram(
+                "pio_ingest_flush_duration_seconds",
+                "Wall time of one group-commit flush (including retries)")
+
+    # -- caller side ---------------------------------------------------------
+    def queue_depth(self) -> int:
+        return self._depth
+
+    def _retry_after(self, depth: int) -> int:
+        est = (depth / self.flush_max) * self._last_flush_s
+        return int(min(60, max(1, est + 0.999)))
+
+    def submit(self, events: Sequence[Event], app_id: int,
+               channel_id: Optional[int] = None
+               ) -> "concurrent.futures.Future[List[str]]":
+        """Queue events for group commit; returns a future of their ids.
+
+        Ids are assigned HERE (idempotency token for the retrying flush).
+        Raises :class:`BufferFull` instead of queueing past ``queue_max``.
+        """
+        events = [e if e.event_id else _with_id(e) for e in events]
+        future: concurrent.futures.Future = concurrent.futures.Future()
+        with self._cond:
+            if self._stopping:
+                raise StorageError("write buffer is shut down")
+            if self._depth + len(events) > self.queue_max:
+                if self._shed_total is not None:
+                    self._shed_total.inc(len(events))
+                raise BufferFull(self._depth, self._retry_after(self._depth))
+            self._queue.append(_Pending(events, app_id, channel_id, future))
+            self._depth += len(events)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, daemon=True, name="pio-ingest-writer")
+                self._thread.start()
+            self._cond.notify()
+        return future
+
+    # -- writer side ---------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait()
+                if not self._queue and self._stopping:
+                    return
+                batch = [self._queue.popleft()]
+                total = len(batch[0].events)
+                # linger: hold the first events briefly so concurrent
+                # submits coalesce — but never once the flush is full.
+                # During a drain only the timed WAIT is skipped: already-
+                # queued items must still coalesce, or a deep queue would
+                # drain as per-request flushes and blow the stop timeout.
+                deadline = time.monotonic() + self.linger_s
+                while total < self.flush_max:
+                    if self._queue:
+                        batch.append(self._queue.popleft())
+                        total += len(batch[-1].events)
+                        continue
+                    if self._stopping:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        break
+            try:
+                self._flush(batch, total)
+            finally:
+                with self._cond:
+                    self._depth -= total
+
+    def _flush(self, batch: List[_Pending], total: int) -> None:
+        """One group commit: per-(app, channel) insert_batch with retries."""
+        t0 = time.monotonic()
+        if self._flush_size is not None:
+            self._flush_size.observe(total)
+        groups: dict = {}
+        for p in batch:
+            groups.setdefault((p.app_id, p.channel_id), []).append(p)
+        for (app_id, channel_id), pendings in groups.items():
+            events = [e for p in pendings for e in p.events]
+            try:
+                ids = self._flush_group(events, app_id, channel_id)
+            except Exception as e:  # noqa: BLE001 — fanned out to callers
+                for p in pendings:
+                    if not p.future.set_running_or_notify_cancel():
+                        continue
+                    p.future.set_exception(
+                        e if isinstance(e, StorageError)
+                        else StorageError(str(e)))
+                continue
+            pos = 0
+            for p in pendings:
+                n = len(p.events)
+                if p.future.set_running_or_notify_cancel():
+                    p.future.set_result(list(ids[pos:pos + n]))
+                pos += n
+        # feed the Retry-After estimate with the observed flush time
+        self._last_flush_s = max(0.001, time.monotonic() - t0)
+        if self._flush_duration is not None:
+            self._flush_duration.observe(time.monotonic() - t0)
+
+    def _flush_group(self, events, app_id, channel_id) -> List[str]:
+        """insert_batch with bounded retries; attempts after the first go
+        through insert_batch_idempotent so an ambiguous failure (backend
+        committed, then the fault fired) cannot duplicate rows."""
+        delay = self.backoff_s
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            store = self._store_fn()
+            fn = (store.insert_batch if attempt == 0
+                  else store.insert_batch_idempotent)
+            running = _start_attempt(fn, (events, app_id, channel_id))
+            try:
+                return running.result(timeout=self.flush_timeout_s)
+            # running.done() distinguishes "our wait timed out" from "the
+            # backend RAISED a timeout" — on 3.11+ futures.TimeoutError IS
+            # builtin TimeoutError, so socket/fsspec timeouts land in this
+            # except clause too and must take the plain retry path
+            except concurrent.futures.TimeoutError as te:
+                if running.done():
+                    last_err = _as_storage_error(te)
+                else:
+                    # the attempt is STILL running — retrying concurrently
+                    # could duplicate on backends whose idempotent insert
+                    # is a non-atomic scan-then-write (parquet: the hung
+                    # attempt's tmp file is invisible to the retry's id
+                    # scan until its rename). Give it one grace period
+                    # and adopt its outcome; a write that never resolves
+                    # fails the batch WITHOUT a retry — the caller gets an
+                    # error (no loss: nothing was acknowledged) instead of
+                    # a possible double-write.
+                    try:
+                        return running.result(timeout=self.flush_timeout_s)
+                    except concurrent.futures.TimeoutError as te2:
+                        if not running.done():
+                            raise StorageError(
+                                f"flush hung past {2 * self.flush_timeout_s}"
+                                "s; failing without retry (a concurrent "
+                                "retry could duplicate events)") from None
+                        last_err = _as_storage_error(te2)
+                    except Exception as e:  # resolved clean failure: retry
+                        last_err = _as_storage_error(e)
+            except Exception as e:
+                # retry ANY failure, not just StorageError: transient
+                # backend faults surface as raw driver/filesystem errors
+                # too (psycopg OperationalError, fsspec OSError) — the
+                # idempotent retry path makes replaying them safe either
+                # way. CrashError (BaseException) still bypasses.
+                last_err = _as_storage_error(e)
+            if attempt == self.retries:
+                break
+            if self._retry_total is not None:
+                self._retry_total.inc()
+            # exponential backoff with full jitter, capped
+            time.sleep(random.uniform(0, min(self.backoff_cap_s, delay)))
+            delay *= 2
+        raise last_err  # type: ignore[misc]
+
+    # -- lifecycle -----------------------------------------------------------
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop the writer. ``drain=True`` flushes everything still queued
+        first (the graceful-shutdown contract: accepted events are never
+        dropped); ``drain=False`` fails pending futures immediately."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                dropped, self._queue = list(self._queue), deque()
+                for p in dropped:
+                    self._depth -= len(p.events)
+                    if p.future.set_running_or_notify_cancel():
+                        p.future.set_exception(
+                            StorageError("write buffer stopped before flush"))
+            thread = self._thread
+            self._cond.notify_all()
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+            if thread.is_alive():
+                logger.warning("ingest writer did not drain within %.1fs",
+                               timeout_s)

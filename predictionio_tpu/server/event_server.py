@@ -15,8 +15,12 @@ Route-for-route parity with the reference EventServer
   GET  /webhooks/<name>.json  -> connector liveness           (:delegates)
 
 Auth: accessKey query parameter or `Authorization: Basic <key:>` header;
-optional `channel` query parameter (:92-142). Event writes run in a thread
-pool so sqlite never blocks the event loop.
+optional `channel` query parameter (:92-142). Event writes are group-
+committed through the bounded WriteBuffer (data/write_buffer.py): many
+concurrent requests coalesce into few `insert_batch` flushes, the server
+sheds with 429 + Retry-After once the queue bound is hit, and a graceful
+shutdown drains the buffer before exiting (`PIO_INGEST_BUFFER=0` restores
+the per-request thread-pool write path).
 """
 
 from __future__ import annotations
@@ -30,17 +34,22 @@ from typing import Optional
 from aiohttp import web
 
 from predictionio_tpu.data.event import Event, EventValidationError, parse_event_time, validate_event
+from predictionio_tpu.data.write_buffer import BufferFull, WriteBuffer
 from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
 from predictionio_tpu.server.plugins import PluginContext
 from predictionio_tpu.server.stats import Stats
 from predictionio_tpu.storage.base import StorageError
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.utils.server_config import IngestConfig
 
 logger = logging.getLogger("pio.eventserver")
 
-#: EventServer.scala:66
-MAX_EVENTS_PER_BATCH = 50
+#: EventServer.scala:66 — the NON-CONFIGURED parity default only.
+#: Handlers read the effective cap from their IngestConfig (tunable via
+#: PIO_MAX_EVENTS_PER_BATCH / server.json ingest.maxEventsPerBatch);
+#: this module constant does not reflect runtime configuration.
+MAX_EVENTS_PER_BATCH = IngestConfig.max_events_per_batch
 DEFAULT_PORT = 7070
 
 
@@ -60,9 +69,20 @@ def _json_response(data, status=200):
 class EventServer:
     def __init__(self, stats: bool = False,
                  plugin_context: Optional[PluginContext] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 ingest: Optional[IngestConfig] = None):
         self.stats_enabled = stats
         self.registry = registry or MetricsRegistry()
+        self.ingest_config = ingest or IngestConfig.from_env()
+        self.buffer: Optional[WriteBuffer] = None
+        if self.ingest_config.buffer:
+            ic = self.ingest_config
+            self.buffer = WriteBuffer(
+                store_fn=Storage.get_events,
+                queue_max=ic.queue_max, flush_max=ic.flush_max,
+                linger_s=ic.linger_s, retries=ic.retries,
+                backoff_s=ic.backoff_s, backoff_cap_s=ic.backoff_cap_s,
+                flush_timeout_s=ic.flush_timeout_s, registry=self.registry)
         self.stats = Stats(registry=self.registry)
         self._ingest_total = self.registry.counter(
             "pio_event_ingest_total",
@@ -80,6 +100,14 @@ class EventServer:
         self.app = web.Application(middlewares=[
             observability_middleware(self.registry, "event_server")])
         self._routes()
+        self.app.on_shutdown.append(self._drain_on_shutdown)
+
+    async def _drain_on_shutdown(self, app) -> None:
+        """Graceful shutdown: flush every buffered event before the
+        process exits — accepted (201-pending) events are never dropped."""
+        if self.buffer is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.buffer.stop)
 
     # -- auth ---------------------------------------------------------------
     async def _auth(self, request: web.Request) -> AuthData:
@@ -141,6 +169,24 @@ class EventServer:
         if reason is not None:
             self._rejected_total.inc(reason=reason)
 
+    def _shed_response(self, bf: BufferFull) -> web.Response:
+        """Explicit load shedding: the ingest queue is at its bound."""
+        self._ingest(429, "shed")
+        return web.json_response(
+            {"message": str(bf)}, status=429,
+            headers={"Retry-After": str(bf.retry_after)})
+
+    async def _insert(self, events, auth: AuthData):
+        """Persist events, returning their ids. Group-commit path when the
+        buffer is enabled (BufferFull/StorageError propagate to the
+        caller); direct thread-pool insert_batch otherwise."""
+        if self.buffer is not None:
+            future = self.buffer.submit(events, auth.app_id, auth.channel_id)
+            return await asyncio.wrap_future(future)
+        return await self._run(
+            Storage.get_events().insert_batch, events, auth.app_id,
+            auth.channel_id)
+
     async def handle_root(self, request):
         return _json_response({"status": "alive"})
 
@@ -165,11 +211,15 @@ class EventServer:
                 self._ingest(403, "blocked")
                 return _json_response({"message": str(e)}, status=403)
         try:
-            event_id = await self._run(
-                Storage.get_events().insert, event, auth.app_id, auth.channel_id)
+            event_id = (await self._insert([event], auth))[0]
+        except BufferFull as bf:
+            return self._shed_response(bf)
         except StorageError as e:
-            self._ingest(500, "storage_error")
-            return _json_response({"message": str(e)}, status=500)
+            # buffered failures already exhausted retries: retryable 503;
+            # the direct path keeps the reference's 500
+            status = 503 if self.buffer is not None else 500
+            self._ingest(status, "storage_error")
+            return _json_response({"message": str(e)}, status=status)
         for sniffer in self.plugins.input_sniffers.values():
             try:
                 sniffer.process(auth.app_id, auth.channel_id, event)
@@ -253,10 +303,11 @@ class EventServer:
                 raise ValueError("batch body must be a JSON array")
         except (json.JSONDecodeError, ValueError) as e:
             return _json_response({"message": str(e)}, status=400)
-        if len(body) > MAX_EVENTS_PER_BATCH:
+        max_batch = self.ingest_config.max_events_per_batch
+        if len(body) > max_batch:
             return _json_response(
                 {"message": "Batch request must have less than or equal to "
-                            f"{MAX_EVENTS_PER_BATCH} events"}, status=400)
+                            f"{max_batch} events"}, status=400)
         self._batch_size.observe(len(body))
         results = []
         to_insert = []  # (index, event)
@@ -287,22 +338,30 @@ class EventServer:
                 to_insert.append((i, event))
         if to_insert:
             try:
-                ids = await self._run(
-                    Storage.get_events().insert_batch,
-                    [e for _, e in to_insert], auth.app_id, auth.channel_id)
+                ids = await self._insert([e for _, e in to_insert], auth)
+            except BufferFull as bf:
+                # nothing was accepted: shed the whole request explicitly
+                return self._shed_response(bf)
             except StorageError as e:
-                self._ingest(500, "storage_error")
-                return _json_response({"message": str(e)}, status=500)
-            for (i, event), event_id in zip(to_insert, ids):
-                self._ingest(201)
-                if self.stats_enabled:
-                    self.stats.bookkeeping(auth.app_id, 201, event)
-                for sniffer in self.plugins.input_sniffers.values():
-                    try:
-                        sniffer.process(auth.app_id, auth.channel_id, event)
-                    except Exception:
-                        logger.exception("input sniffer failed")
-                results.append((i, {"status": 201, "eventId": event_id}))
+                # per-event status entries, preserving the reference's
+                # per-event-result semantics: the already-computed 400/403
+                # entries survive, the failed inserts report a retryable
+                # 503 each (not a wholesale 500 discarding the rest)
+                for i, _event in to_insert:
+                    self._ingest(503, "storage_error")
+                    results.append((i, {"status": 503, "message": str(e)}))
+                ids = None
+            if ids is not None:
+                for (i, event), event_id in zip(to_insert, ids):
+                    self._ingest(201)
+                    if self.stats_enabled:
+                        self.stats.bookkeeping(auth.app_id, 201, event)
+                    for sniffer in self.plugins.input_sniffers.values():
+                        try:
+                            sniffer.process(auth.app_id, auth.channel_id, event)
+                        except Exception:
+                            logger.exception("input sniffer failed")
+                    results.append((i, {"status": 201, "eventId": event_id}))
         results.sort(key=lambda pair: pair[0])
         return _json_response([r for _, r in results])
 
@@ -351,11 +410,13 @@ class EventServer:
             self._ingest(400, "invalid")
             return _json_response({"message": str(e)}, status=400)
         try:
-            event_id = await self._run(
-                Storage.get_events().insert, event, auth.app_id, auth.channel_id)
+            event_id = (await self._insert([event], auth))[0]
+        except BufferFull as bf:
+            return self._shed_response(bf)
         except StorageError as e:
-            self._ingest(500, "storage_error")
-            return _json_response({"message": str(e)}, status=500)
+            status = 503 if self.buffer is not None else 500
+            self._ingest(status, "storage_error")
+            return _json_response({"message": str(e)}, status=status)
         if self.stats_enabled:
             self.stats.bookkeeping(auth.app_id, 201, event)
         self._ingest(201)
@@ -375,11 +436,12 @@ class EventServer:
 
 def create_event_server(stats: bool = False,
                         plugin_context: Optional[PluginContext] = None,
-                        registry: Optional[MetricsRegistry] = None
+                        registry: Optional[MetricsRegistry] = None,
+                        ingest: Optional[IngestConfig] = None
                         ) -> web.Application:
     """EventServer.createEventServer:528 parity."""
     return EventServer(stats=stats, plugin_context=plugin_context,
-                       registry=registry).app
+                       registry=registry, ingest=ingest).app
 
 
 def run_event_server(ip: str = "localhost", port: int = DEFAULT_PORT,
@@ -388,7 +450,7 @@ def run_event_server(ip: str = "localhost", port: int = DEFAULT_PORT,
     from predictionio_tpu.utils.server_config import ServerConfig
 
     cfg = ServerConfig.load()
-    app = create_event_server(stats=stats)
+    app = create_event_server(stats=stats, ingest=cfg.ingest)
     ssl_ctx = cfg.ssl_context()
     logger.info("Event Server listening on %s:%s%s", ip, port,
                 " (TLS)" if ssl_ctx else "")

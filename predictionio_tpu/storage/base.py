@@ -21,9 +21,10 @@ from __future__ import annotations
 import abc
 import dataclasses
 import datetime as _dt
+import os
+import random
 import re
 import secrets
-import uuid
 from typing import Dict, Iterator, List, Optional, Sequence
 
 from predictionio_tpu.data.datamap import PropertyMap
@@ -51,9 +52,27 @@ class _Unfiltered:
 UNFILTERED = _Unfiltered()
 
 
+#: urandom-seeded PRNG for id generation. uuid4() draws from os.urandom
+#: per call — a syscall that costs ~90us under sandboxed kernels, which
+#: at group-commit ingest rates dominated the submit path. Ids need
+#: uniqueness (128 random bits), not cryptographic strength; one urandom
+#: seed per process keeps independent processes collision-free.
+_id_rng = random.Random()
+if hasattr(os, "register_at_fork"):
+    # a forked child inherits the parent's PRNG state; without a reseed
+    # both sides would emit the SAME id stream and the idempotent insert
+    # paths would silently drop the child's events as duplicates
+    os.register_at_fork(after_in_child=_id_rng.seed)
+
+
 def generate_id() -> str:
-    """Random identifier for events/instances (JDBCUtils.generateId parity)."""
-    return uuid.uuid4().hex
+    """Random identifier for events/instances (JDBCUtils.generateId parity).
+
+    No lock: random.Random.getrandbits is a single C call, atomic under
+    the GIL (a shared lock here would also be a fork-time deadlock
+    hazard — a child forked while another thread held it could never
+    generate an id again)."""
+    return f"{_id_rng.getrandbits(128):032x}"
 
 
 # ---------------------------------------------------------------------------
@@ -413,6 +432,50 @@ class EventStore(abc.ABC):
                      channel_id: Optional[int] = None) -> List[str]:
         """LEvents.futureInsertBatch:106 — override for bulk backends."""
         return [self.insert(e, app_id, channel_id) for e in events]
+
+    def insert_batch_idempotent(self, events: Sequence[Event], app_id: int,
+                                channel_id: Optional[int] = None
+                                ) -> List[str]:
+        """Like insert_batch, but events whose (pre-assigned) id is already
+        persisted are skipped instead of duplicated or rejected — the
+        retry contract of the group-commit flush path
+        (data/write_buffer.py): after an AMBIGUOUS failure (fault fired
+        after the backend may have committed) the retry must neither lose
+        nor double-write. Every event must carry an event_id. Returns the
+        ids in input order. Backends override with a native upsert-ignore
+        (sqlite INSERT OR IGNORE, postgres ON CONFLICT DO NOTHING); this
+        default probes with get() per event — correct everywhere, slow,
+        and only ever on the retry path."""
+        missing = []
+        for e in events:
+            if not e.event_id:
+                raise StorageError(
+                    "insert_batch_idempotent requires pre-assigned event ids")
+            if self.get(e.event_id, app_id, channel_id) is None:
+                missing.append(e)
+        if missing:
+            self.insert_batch(missing, app_id, channel_id)
+        return [e.event_id for e in events]
+
+    def compact(self, app_id: int, channel_id: Optional[int] = None,
+                ttl_days: Optional[float] = None) -> Dict[str, int]:
+        """Maintenance sweep: fold deletes into storage, merge small
+        physical units, and (when ``ttl_days`` is given) drop events with
+        ``event_time`` older than the retention window. Returns counter
+        stats (keys vary by backend; ``removed_rows`` is always present).
+        Runnable via ``pio compact``. The default covers retention only,
+        via the row API — correct for every backend; bulk backends
+        override (sqlite/postgres: one DELETE; parquet: crash-safe
+        fragment rewrite, storage/parquet_events.py)."""
+        removed = 0
+        if ttl_days is not None:
+            cutoff = _utcnow() - _dt.timedelta(days=ttl_days)
+            expired = [e.event_id for e in self.find(
+                app_id, channel_id, until_time=cutoff) if e.event_id]
+            for eid in expired:
+                if self.delete(eid, app_id, channel_id):
+                    removed += 1
+        return {"removed_rows": removed}
 
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int,

@@ -19,7 +19,9 @@ HBase's uuid-suffixed rowkeys, HBEventsUtil.scala:76-131).
 from __future__ import annotations
 
 import datetime as _dt
+import fnmatch
 import json
+import time
 import uuid
 from typing import Iterator, List, Optional, Sequence
 
@@ -35,6 +37,22 @@ from predictionio_tpu.storage.base import StorageError, UNFILTERED, generate_id
 
 from predictionio_tpu.storage.sqlite_backend import _from_ms, _tz_offset_min
 
+#: how many times an unsharded read restarts on a fresh fragment list when
+#: a concurrent compaction removes files mid-scan
+_READ_RETRIES = 5
+#: how many times a raw directory listing retries when a concurrent unlink
+#: races the per-entry stat (see ParquetEvents._ls)
+_LIST_RETRIES = 50
+#: tmp-* files younger than this are presumed owned by a live insert flush
+#: and are never garbage-collected (see ParquetEvents._recover)
+_TMP_GC_AGE_S = 3600.0
+#: a tombstone whose cutoff cannot be parsed hides every row of the id —
+#: fail-safe toward staying deleted (int64-safe; epoch-nanos seqs stay
+#: below this until ~2116). The fragment/tombstone layout is versioned
+#: WITH the code: stores written by older revisions are not migrated
+#: (dev-stage storage format; re-ingest via pio import/export instead).
+_FOREVER_SEQ = 1 << 62
+
 STORE_SCHEMA = pa.schema([
     ("id", pa.string()),
     ("event", pa.string()),
@@ -49,6 +67,11 @@ STORE_SCHEMA = pa.schema([
     ("prId", pa.string()),
     ("creationTime", pa.int64()),
     ("creationTimeZone", pa.int32()),
+    # write sequence (epoch nanos, backend-internal — never exported):
+    # orders rows sharing an id far below creationTime's ms resolution,
+    # so delete-cutoff tombstones and latest-wins dedup are exact even
+    # for same-millisecond delete-then-reinsert
+    ("seq", pa.int64()),
 ])
 
 
@@ -102,8 +125,97 @@ class ParquetEvents(base.EventStore):
                 "not initialized. Was the app initialized (pio app new)?")
         return ns
 
-    def _fragments(self, ns: str) -> List[str]:
-        return sorted(self.client.fs.glob(f"{ns}/part-*.parquet"))
+    def _ls(self, ns: str) -> List[str]:
+        """Raw namespace listing, safe against concurrent maintenance.
+
+        NOT fs.glob/fs.find: their directory walk swallows the listing
+        race (an entry unlinked between scandir and its stat makes ls
+        raise, and walk 'omits' the whole directory) and silently
+        returns [] — indistinguishable from an empty store, so a reader
+        concurrent with compaction's unlinks would see zero rows with no
+        error to retry on. fs.ls raises instead of swallowing; retry
+        until a clean pass (unlink windows are microseconds)."""
+        last: Optional[Exception] = None
+        for _ in range(_LIST_RETRIES):
+            try:
+                return list(self.client.fs.ls(ns, detail=False))
+            except FileNotFoundError as ex:
+                last = ex
+        raise StorageError(
+            f"listing {ns} kept failing under concurrent maintenance: "
+            f"{last}")
+
+    def _names(self, ns: str, pattern: str,
+               names: Optional[List[str]] = None) -> List[str]:
+        """Namespace entries whose basename matches `pattern`."""
+        names = self._ls(ns) if names is None else names
+        return sorted(n for n in names
+                      if fnmatch.fnmatch(n.rsplit("/", 1)[-1], pattern))
+
+    def _fragments(self, ns: str,
+                   names: Optional[List[str]] = None) -> List[str]:
+        """Live fragment list — manifest-aware.
+
+        A committed compaction manifest (``compact-*.json``, written
+        atomically) supersedes its ``old`` fragments with one merged file
+        (``final`` once renamed, else still under its ``pending`` name).
+        Applying the manifest during listing means the swap is atomic for
+        readers at every crash point of the multi-file finish sequence:
+        they see either the pre-compaction set or the merged set, never
+        both (duplication) and never neither (loss)."""
+        names = self._ls(ns) if names is None else names
+        parts = set(self._names(ns, "part-*.parquet", names))
+        for mpath in self._names(ns, "compact-*.json", names):
+            m = self._read_manifest(mpath)
+            if m is None:      # finished (or torn tmp never committed)
+                continue
+            parts -= set(m["old"])
+            final, pending = m.get("final"), m.get("pending")
+            if final and final not in parts:
+                # pending checked FIRST: the finish step renames
+                # pending -> final atomically, so pending-gone implies
+                # final-exists; checking final first races the rename
+                # (both probes can miss and the merged rows vanish)
+                if pending and self.client.fs.exists(pending):
+                    parts.add(pending)
+                elif self.client.fs.exists(final):
+                    parts.add(final)
+        return sorted(parts)
+
+    def _manifests(self, ns: str) -> List[str]:
+        return self._names(ns, "compact-*.json")
+
+    # -- namespace generation (compaction/read race detector) ---------------
+    # While a compaction manifest is present, readers are immune to torn
+    # directory listings: the manifest names the merged file explicitly
+    # (exists-probe, not scandir) and excludes every superseded fragment.
+    # The one unguarded window is the manifest's own removal — a scandir
+    # racing the finish steps can return a torn part-* listing (even an
+    # empty one) AND miss the just-removed manifest, leaving no stale
+    # path whose failed open would trigger a retry. The generation file
+    # closes it: _finish bumps it (atomic tmp+rename write) immediately
+    # BEFORE removing the manifest, and readers compare the value from
+    # before and after their scan — a bump in between forces a restart.
+
+    def _gen(self, ns: str) -> str:
+        try:
+            with self.client.fs.open(f"{ns}/_pio_gen", "rb") as f:
+                return f.read().decode()
+        except (OSError, ValueError):
+            return ""
+
+    def _bump_gen(self, ns: str) -> None:
+        tmp = f"{ns}/tmp-{uuid.uuid4().hex}"
+        with self.client.fs.open(tmp, "wb") as f:
+            f.write(generate_id().encode())
+        self.client.fs.mv(tmp, f"{ns}/_pio_gen")
+
+    def _read_manifest(self, path: str) -> Optional[dict]:
+        try:
+            with self.client.fs.open(path, "rb") as f:
+                return json.loads(f.read().decode())
+        except (OSError, ValueError):
+            return None
 
     # -- CRUD ---------------------------------------------------------------
     def insert(self, event: Event, app_id: int,
@@ -132,49 +244,224 @@ class ParquetEvents(base.EventStore):
             cols["prId"].append(e.pr_id)
             cols["creationTime"].append(_to_ms(e.creation_time))
             cols["creationTimeZone"].append(_tz_offset_min(e.creation_time))
-        # caller-supplied ids may reuse a previously-deleted id; scrub the
-        # dead physical rows and their tombstones first so delete-then-
-        # reinsert matches the SQL backends (event visible again, once).
-        # Fresh generated ids can never collide, so the common path skips it.
-        provided = {e.event_id for e in events if e.event_id}
-        if provided:
-            self._scrub(ns, provided & self._tombstones(ns))
+            cols["seq"].append(time.time_ns())
+        # pure append — the ONLY mutation inserts ever perform. A reused
+        # previously-deleted id needs no special handling: tombstones are
+        # cutoff-scoped (they hide rows CREATED BEFORE the delete, see
+        # delete()), so the reinserted row is simply newer than the
+        # cutoff and visible, while the dead physical row stays hidden
+        # until compaction folds it. Nothing an insert writes can ever
+        # appear in a concurrent compaction manifest's old list, so
+        # inserts can never race compaction into losing or duplicating.
         self._write_fragment(ns, pa.table(cols, schema=STORE_SCHEMA))
         return ids
 
-    def _scrub(self, ns: str, dead_ids: set) -> None:
-        """Physically drop rows with `dead_ids` and their tombstone files.
-        New replacement fragments are written before old ones are removed, so
-        a crash can duplicate-but-never-lose unrelated rows."""
-        if not dead_ids:
-            return
-        value_set = pa.array(sorted(dead_ids))
-        for path in self._fragments(ns):
-            with self.client.fs.open(path, "rb") as f:
-                t = pq.read_table(f)
-            mask = pc.is_in(t.column("id"), value_set=value_set)
-            if not pc.any(mask).as_py():
-                continue
-            kept = t.filter(pc.invert(mask))
-            if kept.num_rows:
-                self._write_fragment(ns, kept)
-            self.client.fs.rm(path)
-        for path in self.client.fs.glob(f"{ns}/tomb-*"):
-            with self.client.fs.open(path, "rb") as f:
-                if f.read().decode() in dead_ids:
-                    self.client.fs.rm(path)
-
-    def _write_fragment(self, ns: str, table: pa.Table) -> None:
+    def _write_fragment(self, ns: str, table: pa.Table) -> str:
         path = f"{ns}/part-{uuid.uuid4().hex}.parquet"
-        with self.client.fs.open(path, "wb") as f:
-            pq.write_table(table, f)
+        self._write_parquet(path, table)
+        return path
+
+    def _write_parquet(self, path: str, table: pa.Table) -> None:
+        # temp-write + rename (the FSModels.insert pattern): a crash mid-
+        # write leaves only a tmp-* file no glob matches, never a torn
+        # fragment visible to _fragments(); the tmp stays in the same
+        # directory so the final mv is a metadata move, not a copy
+        ns = path.rsplit("/", 1)[0]
+        tmp = f"{ns}/tmp-{uuid.uuid4().hex}"
+        try:
+            with self.client.fs.open(tmp, "wb") as f:
+                pq.write_table(table, f)
+            self.client.fs.mv(tmp, path)
+        except BaseException:
+            try:
+                if self.client.fs.exists(tmp):
+                    self.client.fs.rm(tmp)
+            except Exception:
+                pass
+            raise
+
+    def insert_batch_idempotent(self, events: Sequence[Event], app_id: int,
+                                channel_id: Optional[int] = None
+                                ) -> List[str]:
+        """Retry-path insert: skip ids already present in any live
+        fragment, so a replayed flush after an ambiguous failure cannot
+        duplicate rows across fragments."""
+        ns = self._check_ns(app_id, channel_id)
+        ids = []
+        for e in events:
+            if not e.event_id:
+                raise StorageError(
+                    "insert_batch_idempotent requires pre-assigned event ids")
+            ids.append(e.event_id)
+        existing = self._existing_ids(ns, set(ids))
+        missing = [e for e in events if e.event_id not in existing]
+        if missing:
+            self.insert_batch(missing, app_id, channel_id)
+        return ids
+
+    def _existing_ids(self, ns: str, candidates: set) -> set:
+        """Which of `candidates` are already stored as LIVE rows (id+seq
+        scan checked against tombstone cutoffs — a dead physical row left
+        by delete must not count, or the idempotent retry would skip a
+        legitimate reinsert of a deleted id and ack an invisible write);
+        restarts on a fresh fragment list if compaction rewrites mid-scan
+        (a stale list could miss the merged fragment -> duplicates)."""
+        value_set = pa.array(sorted(candidates))
+        for _ in range(_READ_RETRIES):
+            gen = self._gen(ns)
+            dead = self._tombstones(ns)
+            newest: dict = {}
+            try:
+                for path in self._fragments(ns):
+                    with self.client.fs.open(path, "rb") as f:
+                        t = pq.read_table(f, columns=["id", "seq"])
+                    t = t.filter(pc.is_in(t.column("id"),
+                                          value_set=value_set))
+                    for eid, seq in zip(t.column("id").to_pylist(),
+                                        t.column("seq").to_pylist()):
+                        newest[eid] = max(newest.get(eid, 0), seq)
+            except FileNotFoundError:
+                continue
+            if self._gen(ns) != gen:
+                continue
+            return {eid for eid, seq in newest.items()
+                    if seq >= dead.get(eid, 0)}
+        raise StorageError(
+            "fragment list kept changing during id scan (concurrent "
+            "compaction); retries exhausted")
+
+    # -- compaction / retention ---------------------------------------------
+    def compact(self, app_id: int, channel_id: Optional[int] = None,
+                ttl_days: Optional[float] = None) -> dict:
+        """Crash-safe maintenance: merge all live fragments into one, fold
+        tombstones, and (with ``ttl_days``) drop events older than the
+        retention window.
+
+        Ordering is write-new-then-remove-old behind an atomically
+        committed manifest:
+
+        1. merged rows are written to a ``merging-*`` file NO glob
+           matches (invisible — a crash here leaves only garbage);
+        2. a ``compact-*.json`` manifest (old fragments, folded
+           tombstones, pending + final names) is renamed into place —
+           THE commit point: from here `_fragments()` serves the merged
+           view even though nothing else moved yet;
+        3. the merged file is renamed ``part-*``, old fragments, folded
+           tombstones and the manifest are removed — every one of these
+           steps is individually crash-safe because step 2 already made
+           the swap logically atomic, and `_recover` rolls an
+           interrupted finish forward on the next compact.
+
+        Concurrent inserts are safe (new fragments are never in the
+        manifest's ``old`` list); concurrent UNSHARDED readers restart on
+        the fresh list; run ONE compactor per namespace at a time."""
+        from predictionio_tpu.storage import faults
+
+        ns = self._check_ns(app_id, channel_id)
+        self._recover(ns)
+        names = self._ls(ns)
+        frags = self._fragments(ns, names)
+        tomb_files = self._names(ns, "tomb-*", names)
+        dead = self._tombstones(ns)
+        stats = {"fragments_before": len(frags),
+                 "tombstones_folded": len(tomb_files),
+                 "removed_rows": 0}
+        tables = []
+        for path in frags:
+            with self.client.fs.open(path, "rb") as f:
+                tables.append(pq.read_table(f))
+        t = (pa.concat_tables(tables) if tables
+             else STORE_SCHEMA.empty_table())
+        rows_before = t.num_rows
+        t = self._drop_dead(t, dead)    # cutoff-scoped tombstone fold
+        t = _dedup_latest(t)            # reinsert-after-delete leftovers
+        expired = 0
+        if ttl_days is not None and t.num_rows:
+            cutoff = _to_ms(_dt.datetime.now(tz=_dt.timezone.utc)
+                            - _dt.timedelta(days=ttl_days))
+            kept = t.filter(pc.greater_equal(t.column("eventTime"), cutoff))
+            expired = t.num_rows - kept.num_rows
+            t = kept
+        if len(frags) <= 1 and not tomb_files and expired == 0:
+            stats["fragments_after"] = len(frags)   # nothing to do
+            return stats
+        cid = uuid.uuid4().hex
+        pending = None
+        if t.num_rows:
+            pending = f"{ns}/merging-{cid}.parquet"
+            self._write_parquet(pending, t)
+        faults.maybe_kill("compact:pending-written")
+        manifest = {"old": frags, "tombs": tomb_files, "pending": pending,
+                    "final": f"{ns}/part-{cid}.parquet" if pending else None}
+        mtmp = f"{ns}/tmp-{uuid.uuid4().hex}"
+        with self.client.fs.open(mtmp, "wb") as f:
+            f.write(json.dumps(manifest).encode())
+        self.client.fs.mv(mtmp, f"{ns}/compact-{cid}.json")  # COMMIT
+        faults.maybe_kill("compact:committed")
+        self._finish(ns, f"{ns}/compact-{cid}.json", manifest)
+        stats["removed_rows"] = rows_before - t.num_rows
+        stats["expired_rows"] = expired
+        stats["fragments_after"] = len(self._fragments(ns))
+        return stats
+
+    def _finish(self, ns: str, mpath: str, manifest: dict) -> None:
+        """Roll a committed manifest forward; idempotent at every step."""
+        from predictionio_tpu.storage import faults
+
+        fs = self.client.fs
+        pending, final = manifest.get("pending"), manifest.get("final")
+        if pending and fs.exists(pending):
+            fs.mv(pending, final)
+        faults.maybe_kill("compact:renamed")
+        for path in manifest["old"]:
+            if fs.exists(path):
+                fs.rm(path)
+        faults.maybe_kill("compact:old-removed")
+        for path in manifest["tombs"]:
+            if fs.exists(path):
+                fs.rm(path)
+        # bump the namespace generation BEFORE dropping the manifest:
+        # readers whose scan overlaps the removal restart instead of
+        # trusting a possibly-torn listing (see _gen)
+        self._bump_gen(ns)
+        faults.maybe_kill("compact:gen-bumped")
+        if fs.exists(mpath):
+            fs.rm(mpath)
+
+    def _recover(self, ns: str) -> None:
+        """Roll forward committed manifests a crashed compaction left
+        behind, then GC crash garbage. merging-* files are written only
+        by compaction (one compactor per namespace), so after the
+        roll-forward any survivor is pre-commit garbage and safe to drop
+        immediately. tmp-* files are ALSO written by live insert flushes
+        in other processes — removing a temp mid-write would fail that
+        flush's rename — so they are only collected once old enough that
+        no live write can still own them."""
+        fs = self.client.fs
+        for mpath in self._manifests(ns):
+            m = self._read_manifest(mpath)
+            if m is not None:
+                self._finish(ns, mpath, m)
+        for path in self._names(ns, "merging-*.parquet"):
+            if fs.exists(path):
+                fs.rm(path)
+        for path in self._names(ns, "tmp-*"):
+            try:
+                age_s = time.time() - fs.modified(path).timestamp()
+            except Exception:
+                continue    # backend without mtimes: leak rather than race
+            if age_s > _TMP_GC_AGE_S and fs.exists(path):
+                fs.rm(path)
 
     def read_snapshot(self, app_id: int,
                       channel_id: Optional[int] = None) -> List[str]:
         """Stable fragment list for partitioned reads: capture ONCE (on
         one process), broadcast, and pass as shard=(idx, count, snapshot)
         so every reader partitions the SAME fragments even while writers
-        keep appending new ones."""
+        keep appending new ones. A `compact()` run invalidates held
+        snapshots — partitioned reads then fail with a clear StorageError
+        (re-snapshot and retry); unsharded readers transparently restart
+        on the fresh list."""
         return self._fragments(self._check_ns(app_id, channel_id))
 
     def snapshot_digest(self, app_id: int,
@@ -184,65 +471,138 @@ class ParquetEvents(base.EventStore):
         import hashlib
 
         ns = self._check_ns(app_id, channel_id)
-        state = ";".join(self._fragments(ns)) + "|" + ";".join(
-            sorted(self.client.fs.glob(f"{ns}/tomb-*")))
+        names = self._ls(ns)
+        state = ";".join(self._fragments(ns, names)) + "|" + ";".join(
+            self._names(ns, "tomb-*", names))
         return "frags:" + hashlib.sha1(state.encode()).hexdigest()
 
     def _read_all(self, ns: str, shard=None) -> pa.Table:
-        if shard is not None:
-            idx, count = shard[0], shard[1]
-            if not (0 <= idx < count):
-                raise StorageError(f"bad shard {shard}")
-            frags = (list(shard[2]) if len(shard) > 2 and shard[2]
-                     is not None else self._fragments(ns))
-            frags = frags[idx::count]
-        else:
-            frags = self._fragments(ns)
-        if not frags:
-            return STORE_SCHEMA.empty_table()
-        tables = []
-        for path in frags:
-            with self.client.fs.open(path, "rb") as f:
-                tables.append(pq.read_table(f))
-        t = pa.concat_tables(tables)
-        dead = self._tombstones(ns)
-        if dead:
-            t = t.filter(pc.invert(pc.is_in(
-                t.column("id"), value_set=pa.array(sorted(dead)))))
-        return t
+        explicit_snapshot = (shard is not None and len(shard) > 2
+                             and shard[2] is not None)
+        for _ in range(_READ_RETRIES):
+            gen = self._gen(ns)
+            # tombstones BEFORE fragments: compaction folds tombstones
+            # into the merged fragment and then deletes the tomb files —
+            # reading them after a successful old-fragment read could
+            # resurrect deleted rows. Read this way, a reader either
+            # opens the old fragments (tomb files still present when they
+            # were read: _finish removes fragments first) or fails the
+            # open and restarts with a fresh view.
+            dead = self._tombstones(ns)
+            if shard is not None:
+                idx, count = shard[0], shard[1]
+                if not (0 <= idx < count):
+                    raise StorageError(f"bad shard {shard}")
+                frags = (list(shard[2]) if explicit_snapshot
+                         else self._fragments(ns))
+                frags = frags[idx::count]
+            else:
+                frags = self._fragments(ns)
+            try:
+                if not frags:
+                    t = STORE_SCHEMA.empty_table()
+                else:
+                    tables = []
+                    for path in frags:
+                        with self.client.fs.open(path, "rb") as f:
+                            tables.append(pq.read_table(f))
+                    t = pa.concat_tables(tables)
+            except FileNotFoundError as ex:
+                if explicit_snapshot:
+                    # a shared multi-process snapshot cannot be refreshed
+                    # unilaterally (partitions would skew) — refuse loudly
+                    raise StorageError(
+                        "fragment snapshot invalidated by compaction "
+                        f"({ex}); capture a fresh read_snapshot() and "
+                        "retry the partitioned read") from ex
+                continue  # compaction rewrote under us: fresh list, restart
+            if not explicit_snapshot and self._gen(ns) != gen:
+                continue  # a compaction finished mid-scan: restart
+            return _dedup_latest(self._drop_dead(t, dead))
+        raise StorageError(
+            "fragment list kept changing during read (concurrent "
+            "compaction); retries exhausted")
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         ns = self._check_ns(app_id, channel_id)
-        if event_id in self._tombstones(ns):
+        for _ in range(_READ_RETRIES):
+            gen = self._gen(ns)
+            cutoff = self._tombstones(ns).get(event_id)
+            matches = []
+            try:
+                for path in self._fragments(ns):
+                    with self.client.fs.open(path, "rb") as f:
+                        t = pq.read_table(f)
+                    t = t.filter(pc.equal(t.column("id"), event_id))
+                    if t.num_rows:
+                        matches.extend(t.to_pylist())
+            except FileNotFoundError:
+                continue  # compaction rewrote under us: restart
+            if self._gen(ns) != gen:
+                continue  # a compaction finished mid-scan: restart
+            if cutoff is not None:
+                matches = [r for r in matches if r["seq"] >= cutoff]
+            if matches:
+                # reinsert-after-delete can leave a dead duplicate row
+                # until compaction folds it: latest write wins
+                return _row_to_event(max(matches, key=lambda r: r["seq"]))
             return None
-        for path in self._fragments(ns):
-            with self.client.fs.open(path, "rb") as f:
-                t = pq.read_table(f)
-            t = t.filter(pc.equal(t.column("id"), event_id))
-            if t.num_rows:
-                return _row_to_event(t.to_pylist()[0])
-        return None
+        raise StorageError(
+            "fragment list kept changing during read (concurrent "
+            "compaction); retries exhausted")
 
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
-        """Tombstone the id: fragments stay append-only and immutable, so a
-        crash can never lose unrelated rows (the object-store-safe delete;
-        compaction can fold tombstones in later)."""
+        """Tombstone the id WITH a cutoff: fragments stay append-only and
+        immutable, so a crash can never lose unrelated rows (the
+        object-store-safe delete; compaction folds tombstones in later).
+        The tombstone hides only rows whose write sequence predates the
+        delete — a later reinsert of the same id is newer than the
+        cutoff and visible without any tombstone mutation, keeping the
+        insert path strictly append-only under concurrent compaction."""
         ns = self._check_ns(app_id, channel_id)
         if self.get(event_id, app_id, channel_id) is None:
             return False
         with self.client.fs.open(
                 f"{ns}/tomb-{uuid.uuid4().hex}", "wb") as f:
-            f.write(event_id.encode())
+            f.write(f"{event_id}\n{time.time_ns()}".encode())
         return True
 
-    def _tombstones(self, ns: str) -> set:
-        ids = set()
-        for path in self.client.fs.glob(f"{ns}/tomb-*"):
-            with self.client.fs.open(path, "rb") as f:
-                ids.add(f.read().decode())
-        return ids
+    def _tombstones(self, ns: str) -> dict:
+        """id -> newest delete-cutoff seq (rows of that id written
+        before the cutoff are dead). Legacy id-only tombstones map to an
+        infinite cutoff (hide every row of the id)."""
+        dead: dict = {}
+        for path in self._names(ns, "tomb-*"):
+            try:
+                with self.client.fs.open(path, "rb") as f:
+                    content = f.read().decode()
+            except FileNotFoundError:
+                # compaction folded this tombstone between glob and open:
+                # its rows are already gone from the merged fragment
+                continue
+            eid, _, cutoff = content.partition("\n")
+            dead[eid] = max(dead.get(eid, 0),
+                            int(cutoff) if cutoff else _FOREVER_SEQ)
+        return dead
+
+    @staticmethod
+    def _drop_dead(t: pa.Table, dead: dict) -> pa.Table:
+        """Filter tombstoned rows: id matches AND the row's write
+        sequence predates that id's delete cutoff. One pass regardless
+        of tombstone count: index_in joins each row to its id's cutoff
+        (null when untombstoned), and a null comparison filled False
+        keeps the row."""
+        if not dead or not t.num_rows:
+            return t
+        ids = sorted(dead)
+        pos = pc.index_in(t.column("id"), value_set=pa.array(ids))
+        row_cutoff = pc.take(
+            pa.array([dead[i] for i in ids], pa.int64()), pos)
+        dead_mask = pc.fill_null(
+            pc.less(t.column("seq"), row_cutoff), False)
+        return t.filter(pc.invert(dead_mask))
 
     # -- queries ------------------------------------------------------------
     def find_columnar(
@@ -341,6 +701,27 @@ class ParquetEvents(base.EventStore):
                  else pc.equal(col, target_entity_id))
             mask = pc.and_(mask, pc.fill_null(m, False))
         return t.filter(mask)
+
+
+def _dedup_latest(t: pa.Table) -> pa.Table:
+    """Resolve duplicate ids to the newest row (by write sequence).
+
+    Reinsert-after-delete leaves the dead physical row in its original
+    fragment (the insert path is strictly append-only so it can never
+    race compaction); reads resolve the pair here and `compact()` folds
+    the loser away physically. The common no-duplicate case is one
+    count_distinct over the id column."""
+    if not t.num_rows:
+        return t
+    if pc.count_distinct(t.column("id")).as_py() == t.num_rows:
+        return t
+    ids = np.asarray(t.column("id").to_pylist())
+    seqs = np.asarray(t.column("seq").to_pylist())
+    order = np.lexsort((seqs, ids))      # by id, then write sequence
+    sorted_ids = ids[order]
+    last_of_id = np.ones(len(order), dtype=bool)
+    last_of_id[:-1] = sorted_ids[:-1] != sorted_ids[1:]
+    return t.take(pa.array(np.sort(order[last_of_id])))
 
 
 def _to_columnar(t: pa.Table, columns=None) -> pa.Table:

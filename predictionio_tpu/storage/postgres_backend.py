@@ -167,25 +167,74 @@ class PostgresEvents(base.EventStore):
                channel_id: Optional[int] = None) -> str:
         return self.insert_batch([event], app_id, channel_id)[0]
 
+    @staticmethod
+    def _event_row(e: Event, eid: str) -> tuple:
+        return (eid, e.event, e.entity_type, e.entity_id,
+                e.target_entity_type, e.target_entity_id,
+                e.properties.to_json() if not e.properties.is_empty else None,
+                _to_ms(e.event_time), _tz_offset_min(e.event_time),
+                ",".join(e.tags) if e.tags else None,
+                e.pr_id, _to_ms(e.creation_time),
+                _tz_offset_min(e.creation_time))
+
+    #: rows per multi-row INSERT: 2000*13 bind params stays well under the
+    #: extended protocol's Int16 parameter-count limit (pg8000 hits it
+    #: near ~2500 rows) while keeping a 256-event flush to one round trip
+    _INSERT_CHUNK_ROWS = 2000
+
+    def _insert_rows(self, name: str, rows: List[tuple],
+                     suffix: str = "") -> None:
+        """Multi-row INSERT in bounded chunks: one round trip per chunk
+        and one atomic statement each (no committed prefix on mid-chunk
+        failure under autocommit), sized for group-commit flushes."""
+        for lo in range(0, len(rows), self._INSERT_CHUNK_ROWS):
+            chunk = rows[lo:lo + self._INSERT_CHUNK_ROWS]
+            placeholders = ",".join(
+                ["(" + ",".join(["%s"] * 13) + ")"] * len(chunk))
+            params = [v for row in chunk for v in row]
+            self.client.execute(
+                f"INSERT INTO {name} VALUES {placeholders}{suffix}", params)
+        self.client.commit()
+
     def insert_batch(self, events: Sequence[Event], app_id: int,
                      channel_id: Optional[int] = None) -> List[str]:
         name = event_table_name(app_id, channel_id)
+        ids = [e.event_id or generate_id() for e in events]
+        self._insert_rows(
+            name, [self._event_row(e, eid) for e, eid in zip(events, ids)])
+        return ids
+
+    def insert_batch_idempotent(self, events: Sequence[Event], app_id: int,
+                                channel_id: Optional[int] = None
+                                ) -> List[str]:
+        """Retry-path insert: ON CONFLICT (id) DO NOTHING, so a replayed
+        flush skips rows a previous ambiguous attempt committed."""
+        name = event_table_name(app_id, channel_id)
         ids = []
         for e in events:
-            eid = e.event_id or generate_id()
-            ids.append(eid)
-            self.client.execute(
-                f"INSERT INTO {name} VALUES "
-                "(%s,%s,%s,%s,%s,%s,%s,%s,%s,%s,%s,%s,%s)",
-                (eid, e.event, e.entity_type, e.entity_id,
-                 e.target_entity_type, e.target_entity_id,
-                 e.properties.to_json() if not e.properties.is_empty else None,
-                 _to_ms(e.event_time), _tz_offset_min(e.event_time),
-                 ",".join(e.tags) if e.tags else None,
-                 e.pr_id, _to_ms(e.creation_time),
-                 _tz_offset_min(e.creation_time)))
-        self.client.commit()
+            if not e.event_id:
+                raise StorageError(
+                    "insert_batch_idempotent requires pre-assigned event ids")
+            ids.append(e.event_id)
+        self._insert_rows(
+            name, [self._event_row(e, e.event_id) for e in events],
+            suffix=" ON CONFLICT (id) DO NOTHING")
         return ids
+
+    def compact(self, app_id: int, channel_id: Optional[int] = None,
+                ttl_days: Optional[float] = None) -> dict:
+        """Retention sweep as one bounded DELETE (row stores have nothing
+        to merge; autovacuum reclaims the space)."""
+        removed = 0
+        if ttl_days is not None:
+            name = event_table_name(app_id, channel_id)
+            cutoff = _to_ms(_dt.datetime.now(tz=UTC)
+                            - _dt.timedelta(days=ttl_days))
+            cur = self.client.execute(
+                f"DELETE FROM {name} WHERE eventTime < %s", (cutoff,))
+            self.client.commit()
+            removed = cur.rowcount
+        return {"removed_rows": removed}
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:

@@ -196,6 +196,14 @@ class Storage:
         stype = source.get("TYPE", "sqlite")
         client = cls._client(source_name)
         obj = _construct(stype, kind, client, source)
+        if kind == "events":
+            from predictionio_tpu.storage import faults
+
+            if faults.env_enabled():
+                # chaos mode: any PIO_FAULT_* knob wraps the event store
+                # in the fault injector (storage/faults.py) — evaluated
+                # once per cache fill, so arm the env before first use
+                obj = faults.FaultyEvents.from_env(obj)
         cls._objects[cache_key] = obj
         return obj
 

@@ -172,6 +172,58 @@ class SqliteEvents(base.EventStore):
                 "Was the app initialized (pio app new)?") from ex
         return ids
 
+    def insert_batch_idempotent(self, events: Sequence[Event], app_id: int,
+                                channel_id: Optional[int] = None
+                                ) -> List[str]:
+        """Retry-path insert: INSERT OR IGNORE on the id primary key, so a
+        replayed flush skips rows a previous ambiguous attempt committed."""
+        name = event_table_name(app_id, channel_id)
+        rows, ids = [], []
+        for e in events:
+            if not e.event_id:
+                raise StorageError(
+                    "insert_batch_idempotent requires pre-assigned event ids")
+            ids.append(e.event_id)
+            rows.append((
+                e.event_id, e.event, e.entity_type, e.entity_id,
+                e.target_entity_type, e.target_entity_id,
+                e.properties.to_json() if not e.properties.is_empty else None,
+                _to_ms(e.event_time), _tz_offset_min(e.event_time),
+                ",".join(e.tags) if e.tags else None,
+                e.pr_id, _to_ms(e.creation_time),
+                _tz_offset_min(e.creation_time),
+            ))
+        try:
+            with self.client.write_lock():
+                self.client.conn().executemany(
+                    f"INSERT OR IGNORE INTO {name} "
+                    "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
+                self.client.conn().commit()
+        except sqlite3.OperationalError as ex:
+            raise StorageError(
+                f"cannot insert into app {app_id} channel {channel_id}: {ex}. "
+                "Was the app initialized (pio app new)?") from ex
+        return ids
+
+    def compact(self, app_id: int, channel_id: Optional[int] = None,
+                ttl_days: Optional[float] = None) -> dict:
+        """Retention sweep as one bounded DELETE (rows are already
+        physically folded in a row store; there is nothing to merge)."""
+        removed = 0
+        if ttl_days is not None:
+            name = event_table_name(app_id, channel_id)
+            cutoff = _to_ms(_dt.datetime.now(tz=UTC)
+                            - _dt.timedelta(days=ttl_days))
+            try:
+                with self.client.write_lock():
+                    cur = self.client.conn().execute(
+                        f"DELETE FROM {name} WHERE eventTime < ?", (cutoff,))
+                    self.client.conn().commit()
+            except sqlite3.OperationalError as ex:
+                raise StorageError(str(ex)) from ex
+            removed = cur.rowcount
+        return {"removed_rows": removed}
+
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         name = event_table_name(app_id, channel_id)

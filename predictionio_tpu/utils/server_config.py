@@ -16,7 +16,9 @@ Config file: ``$PIO_CONF_DIR/server.json`` (or the path in
      "ssl": {"enabled": false, "certfile": "...", "keyfile": "..."},
      "serving": {"batchMax": 64, "batchLingerS": null, "batchInflight": 2},
      "deploy": {"warmup": true, "canaryFraction": 0.1, "canaryWindow": 200,
-                "canaryPromoteAfter": 100, "canaryP99Ratio": 2.0}}
+                "canaryPromoteAfter": 100, "canaryP99Ratio": 2.0},
+     "ingest": {"maxEventsPerBatch": 50, "buffer": true, "queueMax": 8192,
+                "flushMax": 256, "lingerS": 0.002, "retries": 4}}
 
 All fields optional; env vars ``PIO_SERVER_KEY`` / ``PIO_SSL_CERTFILE`` /
 ``PIO_SSL_KEYFILE`` override file values, as do the serving-tuning knobs
@@ -87,6 +89,91 @@ class ServingConfig:
                                name, raw)
         cfg.batch_max = max(1, cfg.batch_max)
         cfg.batch_inflight = max(1, cfg.batch_inflight)
+        return cfg
+
+
+@dataclasses.dataclass
+class IngestConfig:
+    """Event-server ingest tuning (the ``PIO_INGEST_*`` knobs; server.json
+    ``ingest`` section, camelCase keys).
+
+    ``buffer=True`` routes ``/events.json`` and ``/batch/events.json``
+    through the group-commit WriteBuffer (data/write_buffer.py):
+    bounded queue (``queue_max`` EVENTS — past it the server sheds with
+    429 + Retry-After), flushes of up to ``flush_max`` events triggered
+    by size or ``linger_s``, ``retries`` attempts with exponential
+    backoff from ``backoff_s`` (capped at ``backoff_cap_s``) and a
+    ``flush_timeout_s`` bound per storage call. ``buffer=False`` restores
+    the per-request direct write path.
+
+    ``max_events_per_batch`` is the ``/batch/events.json`` request cap
+    (EventServer.scala:66's constant 50, now tunable for bulk loaders).
+    """
+
+    max_events_per_batch: int = 50
+    buffer: bool = True
+    queue_max: int = 8192
+    flush_max: int = 256
+    linger_s: float = 0.002
+    retries: int = 4
+    backoff_s: float = 0.05
+    backoff_cap_s: float = 1.0
+    flush_timeout_s: float = 30.0
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None) -> "IngestConfig":
+        """server.json ``ingest`` section overlaid by env vars (env wins);
+        malformed knobs are logged and fall back, same contract as
+        ServingConfig."""
+        data = data or {}
+        cfg = cls()
+        as_bool = lambda v: str(v).strip().lower() not in (  # noqa: E731
+            "0", "false", "no", "off", "")
+        sources = (
+            ("maxEventsPerBatch", data.get("maxEventsPerBatch"),
+             "max_events_per_batch", int),
+            ("buffer", data.get("buffer"), "buffer", as_bool),
+            ("queueMax", data.get("queueMax"), "queue_max", int),
+            ("flushMax", data.get("flushMax"), "flush_max", int),
+            ("lingerS", data.get("lingerS"), "linger_s", float),
+            ("retries", data.get("retries"), "retries", int),
+            ("backoffS", data.get("backoffS"), "backoff_s", float),
+            ("backoffCapS", data.get("backoffCapS"), "backoff_cap_s", float),
+            ("flushTimeoutS", data.get("flushTimeoutS"),
+             "flush_timeout_s", float),
+            ("PIO_MAX_EVENTS_PER_BATCH",
+             os.environ.get("PIO_MAX_EVENTS_PER_BATCH"),
+             "max_events_per_batch", int),
+            ("PIO_INGEST_BUFFER", os.environ.get("PIO_INGEST_BUFFER"),
+             "buffer", as_bool),
+            ("PIO_INGEST_QUEUE_MAX", os.environ.get("PIO_INGEST_QUEUE_MAX"),
+             "queue_max", int),
+            ("PIO_INGEST_FLUSH_MAX", os.environ.get("PIO_INGEST_FLUSH_MAX"),
+             "flush_max", int),
+            ("PIO_INGEST_LINGER_S", os.environ.get("PIO_INGEST_LINGER_S"),
+             "linger_s", float),
+            ("PIO_INGEST_RETRIES", os.environ.get("PIO_INGEST_RETRIES"),
+             "retries", int),
+            ("PIO_INGEST_BACKOFF_S", os.environ.get("PIO_INGEST_BACKOFF_S"),
+             "backoff_s", float),
+            ("PIO_INGEST_BACKOFF_CAP_S",
+             os.environ.get("PIO_INGEST_BACKOFF_CAP_S"),
+             "backoff_cap_s", float),
+            ("PIO_INGEST_FLUSH_TIMEOUT_S",
+             os.environ.get("PIO_INGEST_FLUSH_TIMEOUT_S"),
+             "flush_timeout_s", float),
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed ingest knob %s=%r",
+                               name, raw)
+        cfg.max_events_per_batch = max(1, cfg.max_events_per_batch)
+        cfg.queue_max = max(1, cfg.queue_max)
+        cfg.flush_max = max(1, cfg.flush_max)
         return cfg
 
 
@@ -180,6 +267,7 @@ class ServerConfig:
     keyfile: Optional[str] = None
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     deploy: DeployConfig = dataclasses.field(default_factory=DeployConfig)
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "ServerConfig":
@@ -204,6 +292,7 @@ class ServerConfig:
             keyfile=ssl_conf.get("keyfile"),
             serving=ServingConfig.from_env(data.get("serving") or {}),
             deploy=DeployConfig.from_env(data.get("deploy") or {}),
+            ingest=IngestConfig.from_env(data.get("ingest") or {}),
         )
         if os.environ.get("PIO_SERVER_KEY"):
             cfg.key = os.environ["PIO_SERVER_KEY"]

@@ -114,6 +114,35 @@ def test_cli_full_lifecycle(clienv, tmp_path, monkeypatch):
     assert n == 600
 
 
+def test_cli_compact_ttl(clienv, tmp_path):
+    """`pio compact --appname --ttl-days` runs the retention sweep and
+    echoes the stats (README 'Ingest hardening')."""
+    import datetime as dt
+
+    from predictionio_tpu.data.event import Event, UTC
+    from predictionio_tpu.data.eventstore import resolve_app
+    from predictionio_tpu.storage import Storage
+
+    r = CliRunner()
+    _ok(r.invoke(cli, ["app", "new", "compactapp"]))
+    app_id, _ = resolve_app("compactapp", None)
+    store = Storage.get_events()
+    now = dt.datetime.now(tz=UTC)
+    store.insert_batch([Event(
+        event="view", entity_type="user", entity_id=f"u{i}",
+        event_time=now - dt.timedelta(days=30)) for i in range(4)], app_id)
+    keep = store.insert_batch([Event(
+        event="view", entity_type="user", entity_id="fresh",
+        event_time=now)], app_id)
+    out = _ok(r.invoke(cli, ["compact", "--appname", "compactapp",
+                             "--ttl-days", "7"]))
+    assert "Compacted app" in out
+    assert '"removed_rows": 4' in out
+    assert [e.event_id for e in store.find(app_id)] == keep
+    res = r.invoke(cli, ["compact", "--appname", "ghost"])
+    assert res.exit_code == 1
+
+
 def test_cli_import_requires_app(clienv, tmp_path):
     r = CliRunner()
     bad = tmp_path / "nope.json"

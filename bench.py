@@ -874,6 +874,127 @@ def cfg_eval_sweep(jax, mesh, platform):
                      f"max |seq-batched| RMSE diff {max_diff:.1e}")}
 
 
+def _als_kernel_shape():
+    """The als_kernel sweep shape, env-overridable so the smoke test can
+    shrink it. Defaults are the CPU-feasible judged shape; on TPU the
+    same ranks run at whatever BENCH_ALS_* scale the round sets."""
+    nu = int(os.environ.get("BENCH_ALS_USERS", 3000))
+    ni = int(os.environ.get("BENCH_ALS_ITEMS", 800))
+    nnz = int(os.environ.get("BENCH_ALS_NNZ", 120_000))
+    iters = int(os.environ.get("BENCH_ALS_ITERS", 5))
+    ranks = [int(r) for r in
+             os.environ.get("BENCH_ALS_RANKS", "16,64,128").split(",") if r]
+    block = int(os.environ.get("BENCH_ALS_BLOCK", 16))
+    # block coordinate descent takes smaller steps per outer iteration, so
+    # the subspace side runs factor x the iterations and parity is judged
+    # at MATCHED HELD-OUT QUALITY (the iALS++ time-to-quality protocol,
+    # arXiv:2110.14044 fig. 2) — throughput claims at equal iteration
+    # counts but unequal quality would be fake
+    factor = float(os.environ.get("BENCH_ALS_SUB_ITERS_FACTOR", 1.6))
+    min_speedup = float(os.environ.get("BENCH_ALS_MIN_SPEEDUP", 2.0))
+    slack = float(os.environ.get("BENCH_ALS_RMSE_SLACK", 0.03))
+    return nu, ni, nnz, iters, ranks, block, factor, min_speedup, slack
+
+
+def cfg_als_kernel(jax, mesh, platform):
+    """Training-kernel face-off: full per-row solve vs subspace (iALS++)
+    block coordinate descent, swept over ranks.
+
+    For each rank the FULL solver trains `iters` outer iterations and the
+    SUBSPACE solver `ceil(iters * factor)` — enough block sweeps to reach
+    the same held-out RMSE (asserted within BENCH_ALS_RMSE_SLACK) — and
+    the judged speedup is wall-to-matched-quality, best-of-2 each side.
+    Asserts the >= BENCH_ALS_MIN_SPEEDUP floor at every rank >= 64 (the
+    regime where the full solver's [S, K, K] batched-Cholesky bandwidth
+    wall dominates) and that the als_train compile ledger stays at one
+    entry per (rank, solver) family.
+    """
+    from predictionio_tpu.models.als import (
+        ALSData, ALSParams, train_als, rmse as als_rmse,
+    )
+    from predictionio_tpu.ops import fn_cache
+
+    nu, ni, nnz, iters, ranks, block, factor, min_speedup, slack = \
+        _als_kernel_shape()
+    rng = np.random.default_rng(7)
+    users = rng.integers(0, nu, nnz).astype(np.int32)
+    items = rng.integers(0, ni, nnz).astype(np.int32)
+    # full-spectrum ground truth + noise: a noiseless low-rank synthetic
+    # would let relative RMSE comparisons swing on a ~0 denominator
+    lu = rng.normal(size=(nu, 32)) * (0.9 ** np.arange(32))
+    lv = rng.normal(size=(ni, 32))
+    ratings = (np.einsum("nk,nk->n", lu[users], lv[items]) / 3 + 3
+               + 0.3 * rng.normal(size=nnz)).astype(np.float32)
+    heldout = rng.random(nnz) < 0.1
+    tr = ~heldout
+    hb("als_kernel data-build")
+    data = ALSData.build(users[tr], items[tr], ratings[tr], nu, ni,
+                         n_shards=1).put(mesh)
+    sub_iters = int(np.ceil(iters * factor))
+    keys_before = len(fn_cache.family_keys("als_train"))
+
+    detail = {}
+    total_timed = 0.0
+    notes = []
+    for rank in ranks:
+        sides = {}
+        for solver, n_it in (("full", iters), ("subspace", sub_iters)):
+            p = ALSParams(rank=rank, num_iterations=n_it, reg=0.05, seed=1,
+                          solver=solver, block_size=block)
+            hb(f"als_kernel r{rank} {solver} warmup")
+            train_als(mesh, data, p)        # compile + first run
+            hb(f"als_kernel r{rank} {solver} timed")
+            elapsed, (U, V) = timed_best(
+                lambda: train_als(mesh, data, p), repeats=2)
+            err = als_rmse(U, V, users[heldout], items[heldout],
+                           ratings[heldout])
+            assert np.isfinite(err), f"{solver} diverged at rank {rank}"
+            sides[solver] = (elapsed, err)
+            total_timed += elapsed
+        (t_full, e_full), (t_sub, e_sub) = sides["full"], sides["subspace"]
+        speedup = t_full / t_sub if t_sub else float("inf")
+        detail[f"train_s_full_r{rank}"] = round(t_full, 3)
+        detail[f"train_s_subspace_r{rank}"] = round(t_sub, 3)
+        detail[f"heldout_rmse_full_r{rank}"] = round(float(e_full), 5)
+        detail[f"heldout_rmse_subspace_r{rank}"] = round(float(e_sub), 5)
+        detail[f"iters_per_s_full_r{rank}"] = round(iters / t_full, 3)
+        detail[f"iters_per_s_subspace_r{rank}"] = round(sub_iters / t_sub, 3)
+        detail[f"speedup_r{rank}"] = round(speedup, 2)
+        # held-out parity at matched quality — for EVERY rank
+        assert e_sub <= e_full * (1.0 + slack), (
+            f"rank {rank}: subspace heldout RMSE {e_sub:.4f} vs full "
+            f"{e_full:.4f} exceeds {slack:.0%} slack")
+        if rank >= 64:
+            # the tentpole floor: the subspace solver must actually pay
+            # off where the full solve's K^3 wall bites
+            assert speedup >= min_speedup, (
+                f"rank {rank}: subspace speedup {speedup:.2f}x under the "
+                f"{min_speedup}x floor (full {t_full:.2f}s vs subspace "
+                f"{t_sub:.2f}s)")
+        notes.append(f"r{rank} {speedup:.1f}x")
+
+    ledger = len(fn_cache.family_keys("als_train")) - keys_before
+    assert ledger <= 2 * len(ranks), (
+        f"als_train compiled {ledger} entries for {len(ranks)} ranks x 2 "
+        "solvers — the (rank, block_size) family bound is broken")
+    big = [r for r in ranks if r >= 64]
+    headline = max((detail[f"speedup_r{r}"] for r in big), default=None)
+    detail.update({
+        "elapsed_s": round(total_timed, 3),
+        "ranks": ranks,
+        "block_size": block,
+        "iters_full": iters,
+        "iters_subspace": sub_iters,
+        "rmse_slack": slack,
+        "compile_ledger_delta": ledger,
+        "speedup_headline": headline,
+        "note": (f"full vs subspace(b={block}) at matched held-out "
+                 f"quality, best-of-2: {', '.join(notes)}; "
+                 f"ledger {ledger} <= {2 * len(ranks)}"),
+    })
+    return detail
+
+
 def cfg_serving_batching(jax, mesh, platform):
     """Serving hot path under concurrent load: the bucketed, pipelined
     micro-batcher swept at 1/8/64 clients (BENCH_SERVING_CLIENTS),
@@ -1556,6 +1677,7 @@ CONFIGS = {
     "naive_bayes_spam": (cfg_naive_bayes, 180),
     "ecommerce_implicit_als": (cfg_ecommerce, 240),
     "eval_sweep_grid": (cfg_eval_sweep, 420),
+    "als_kernel": (cfg_als_kernel, 900),
     "serving_batching": (cfg_serving_batching, 240),
     "deploy_swap": (cfg_deploy_swap, 240),
     "train_ingest": (cfg_train_ingest, 240),

@@ -318,6 +318,21 @@ def train(variant, batch, skip_sanity_check, stop_after_read,
 
     engine, engine_params, factory_path, variant_id = \
         _load_engine_variant(variant)
+    # echo the resolved ALS training solver for every ALS-backed
+    # algorithm (engine.json "solver" section + PIO_ALS_SOLVER /
+    # PIO_ALS_BLOCK_SIZE overrides, README "Training kernel")
+    from predictionio_tpu.utils.server_config import als_solver_config
+    for algo_name, algo_params in engine_params.algorithm_params_list:
+        if hasattr(algo_params, "solver"):
+            try:
+                mode, block = als_solver_config(
+                    getattr(algo_params, "solver", None))
+            except ValueError as e:
+                click.echo(f"[ERROR] Algorithm '{algo_name}': {e}. "
+                           "Aborting.")
+                sys.exit(1)
+            click.echo(f"[INFO] Algorithm '{algo_name}': ALS solver "
+                       f"{mode} (block size {block}).")
     runtime_conf = {}
     if mesh_shape:
         runtime_conf["mesh_shape"] = mesh_shape

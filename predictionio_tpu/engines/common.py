@@ -66,3 +66,21 @@ def item_meta_join(item_vocab, items: Dict[str, Item]) -> Dict[int, Item]:
     ids = np.asarray(list(items), dtype=object)
     idxs = batch_lookup(item_vocab, ids)
     return {int(ix): items[str(k)] for ix, k in zip(idxs, ids) if ix >= 0}
+
+
+def resolved_als_solver(algo_params, logger) -> "tuple[str, int]":
+    """Resolve + log the ALS training solver for an engine's train().
+
+    Every ALS-backed engine runs the same sequence — resolve the algo
+    params' optional ``solver`` section through
+    `utils/server_config.als_solver_config` (host server.json ``train``
+    section and ``PIO_ALS_*`` env apply) and log the outcome on the
+    engine's own logger — so it lives here once.
+    """
+    from predictionio_tpu.utils.server_config import als_solver_config
+
+    solver, block_size = als_solver_config(
+        getattr(algo_params, "solver", None))
+    logger.info("ALS solver: %s (block_size=%d, rank=%d)",
+                solver, block_size, algo_params.rank)
+    return solver, block_size

@@ -19,6 +19,7 @@ Query: {"user": ..., "num": N, "categories"?, "whiteList"?, "blackList"?}.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -28,10 +29,12 @@ from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
 from predictionio_tpu.engines.common import (
     InteractionColumns, Item, ItemScore, PredictedResult, categories_match,
-    item_meta_join,
+    item_meta_join, resolved_als_solver,
 )
 from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+
+logger = logging.getLogger("pio.engine.ecommerce")
 
 
 @dataclasses.dataclass
@@ -123,6 +126,9 @@ class ECommAlgorithmParams(Params):
     reg: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    #: {"mode": "full"|"subspace", "block_size": N}; None defers
+    #: to server.json "train" / PIO_ALS_SOLVER overrides
+    solver: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -178,10 +184,12 @@ class ECommAlgorithm(Algorithm):
         data = ALSData.build(user_codes, item_codes, values,
                              len(user_vocab), len(item_vocab),
                              int(np.prod(mesh.devices.shape)))
+        _solver, _block = resolved_als_solver(self.params, logger)
         U, V = train_als(mesh, data, ALSParams(
             rank=self.params.rank, num_iterations=self.params.num_iterations,
             reg=self.params.reg, alpha=self.params.alpha,
-            implicit_prefs=True, seed=self.params.seed))
+            implicit_prefs=True, seed=self.params.seed,
+            solver=_solver, block_size=_block))
         item_meta = item_meta_join(item_vocab, pd.items)
         buy_idx = batch_lookup(item_vocab, pd.buys.items)
         buy_idx = buy_idx[buy_idx >= 0]

@@ -20,6 +20,7 @@ Wire format parity (quickstart): query {"user": "1", "num": 4} ->
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -31,7 +32,10 @@ from predictionio_tpu.core import (
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices
 from predictionio_tpu.data.eventstore import EventStoreClient
+from predictionio_tpu.engines.common import resolved_als_solver
 from predictionio_tpu.models.als import ALSData, ALSModel, ALSParams, train_als
+
+logger = logging.getLogger("pio.engine.recommendation")
 
 
 # -- data types ---------------------------------------------------------------
@@ -270,6 +274,10 @@ class AlgorithmParams(Params):
     seed: int = 3
     implicit_prefs: bool = False
     alpha: float = 1.0
+    #: training-solver selection: {"mode": "full"|"subspace",
+    #: "block_size": N} — None defers to server.json "train" /
+    #: PIO_ALS_SOLVER (utils/server_config.als_solver_config)
+    solver: Optional[dict] = None
 
 
 class ALSAlgorithm(Algorithm):
@@ -320,13 +328,15 @@ class ALSAlgorithm(Algorithm):
             n_shards = int(np.prod(mesh.devices.shape))
             data = ALSData.build(user_codes, item_codes, values,
                                  len(user_vocab), len(item_vocab), n_shards)
+        solver, block_size = resolved_als_solver(self.params, logger)
         als_params = ALSParams(
             rank=self.params.rank,
             num_iterations=self.params.num_iterations,
             reg=self.params.reg,
             seed=self.params.seed,
             implicit_prefs=self.params.implicit_prefs,
-            alpha=self.params.alpha)
+            alpha=self.params.alpha,
+            solver=solver, block_size=block_size)
         from predictionio_tpu.workflow.checkpoint import checkpointer_of
 
         U, V = train_als(mesh, data, als_params,
@@ -394,10 +404,23 @@ class ALSAlgorithm(Algorithm):
             data = build_sweep_data(
                 user_codes, item_codes, cols.values, fold_of,
                 len(user_vocab), len(item_vocab))
-        candidates = [ALSParams(
-            rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
-            seed=p.seed, implicit_prefs=p.implicit_prefs, alpha=p.alpha)
-            for p in algo_params_list]
+        from predictionio_tpu.utils.server_config import (
+            ServerConfig, als_solver_config,
+        )
+
+        # resolve the host-level train section ONCE, not per candidate —
+        # als_solver_config(config=None) re-reads server.json each call
+        train_cfg = ServerConfig.load().train
+
+        def with_solver(p):
+            solver, block_size = als_solver_config(
+                getattr(p, "solver", None), config=train_cfg)
+            return ALSParams(
+                rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
+                seed=p.seed, implicit_prefs=p.implicit_prefs, alpha=p.alpha,
+                solver=solver, block_size=block_size)
+
+        candidates = [with_solver(p) for p in algo_params_list]
         needs_rank = any(k in ("precision_at_k", "topn_mse") for k in kinds)
         if prec_specs:
             pk, threshold = next(iter(prec_specs))

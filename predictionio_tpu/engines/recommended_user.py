@@ -24,6 +24,7 @@ result: {"similarUserScores": [{"user": ..., "score": ...}]}.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -31,7 +32,10 @@ import numpy as np
 from predictionio_tpu.core import Engine, EngineParams, FirstServing, Params, Preparator
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
+from predictionio_tpu.engines.common import resolved_als_solver
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+
+logger = logging.getLogger("pio.engine.recommended_user")
 
 
 # -- data types ---------------------------------------------------------------
@@ -149,6 +153,9 @@ class ALSAlgorithmParams(Params):
     reg: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    #: {"mode": "full"|"subspace", "block_size": N}; None defers
+    #: to server.json "train" / PIO_ALS_SOLVER overrides
+    solver: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -201,11 +208,13 @@ class ALSAlgorithm(Algorithm):
         n_shards = int(np.prod(mesh.devices.shape))
         data = ALSData.build(f_codes, t_codes, values,
                              len(f_vocab), len(t_vocab), n_shards)
+        _solver, _block = resolved_als_solver(self.params, logger)
         _, V = train_als(mesh, data, ALSParams(
             rank=self.params.rank,
             num_iterations=self.params.num_iterations,
             reg=self.params.reg, alpha=self.params.alpha,
-            implicit_prefs=True, seed=self.params.seed))
+            implicit_prefs=True, seed=self.params.seed,
+            solver=_solver, block_size=_block))
         norms = np.linalg.norm(V, axis=1, keepdims=True)
         V = V / np.where(norms == 0, 1.0, norms)
         return RecommendedUserModel(user_vocab=t_vocab, V=V, users=pd.users)

@@ -19,6 +19,7 @@ result: {"itemScores": [{"item": ..., "score": ...}]}.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -28,10 +29,12 @@ from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
 from predictionio_tpu.engines.common import (
     InteractionColumns, Item, ItemScore, PredictedResult, categories_match,
-    item_meta_join,
+    item_meta_join, resolved_als_solver,
 )
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
 from predictionio_tpu.models.cooccurrence import CooccurrenceModel, train_cooccurrence
+
+logger = logging.getLogger("pio.engine.similarproduct")
 
 
 # -- data types ---------------------------------------------------------------
@@ -151,6 +154,9 @@ class ALSAlgorithmParams(Params):
     reg: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    #: {"mode": "full"|"subspace", "block_size": N}; None defers
+    #: to server.json "train" / PIO_ALS_SOLVER overrides
+    solver: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -232,10 +238,12 @@ class ALSAlgorithm(Algorithm):
         n_shards = int(np.prod(mesh.devices.shape))
         data = ALSData.build(user_codes, item_codes, values,
                              len(user_vocab), len(item_vocab), n_shards)
+        _solver, _block = resolved_als_solver(self.params, logger)
         _, V = train_als(mesh, data, ALSParams(
             rank=self.params.rank, num_iterations=self.params.num_iterations,
             reg=self.params.reg, alpha=self.params.alpha,
-            implicit_prefs=True, seed=self.params.seed))
+            implicit_prefs=True, seed=self.params.seed,
+            solver=_solver, block_size=_block))
         norms = np.linalg.norm(V, axis=1, keepdims=True)
         V = V / np.where(norms == 0, 1.0, norms)
         return SimilarityModel(item_vocab=item_vocab, V=V,

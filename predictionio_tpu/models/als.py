@@ -36,8 +36,15 @@ from predictionio_tpu.data.bimap import vocab_index
 from predictionio_tpu.ops.bucketing import bucket_size, pad_rows as _pad_rows
 from predictionio_tpu.ops.fn_cache import shape_cached_fn
 from predictionio_tpu.ops.linalg import batched_spd_solve
-from predictionio_tpu.ops.segment import rows_gram_rhs, segment_count
+from predictionio_tpu.ops.segment import (
+    block_gram_rhs, row_predict_add, rows_gram_rhs, segment_count,
+)
 from predictionio_tpu.ops.topk import host_topk as _host_topk
+
+#: selectable training solvers: "full" = one K x K normal-equations solve
+#: per row per half-sweep (the classic ALS step); "subspace" = iALS++
+#: block coordinate descent over rank blocks (arXiv:2110.14044)
+SOLVERS = ("full", "subspace")
 
 
 @dataclasses.dataclass
@@ -55,6 +62,39 @@ class ALSParams(Params):
     #: rows per lax.scan chunk — bounds the gather/matmul buffer (the padded
     #: row length itself is a data-layout knob on ALSData.build)
     chunk_size: int = 8192
+    #: "full" (per-row K x K solve) or "subspace" (iALS++ block coordinate
+    #: descent: per outer iteration sweep rank blocks of `block_size`,
+    #: solving b x b systems against the frozen remainder — O(r * b^2)
+    #: per row instead of O(r^3), the win compounding as rank grows)
+    solver: str = "full"
+    #: rank-block width of the subspace solver (ignored by "full")
+    block_size: int = 16
+
+
+def validate_solver(params: "ALSParams") -> None:
+    """Loud failure on a typo'd solver config — a silent fallback would
+    fake the full path's perf numbers under a subspace label (or vice
+    versa)."""
+    if params.solver not in SOLVERS:
+        raise ValueError(
+            f"unknown ALS solver {params.solver!r}: expected one of "
+            f"{'|'.join(SOLVERS)}")
+    if params.solver == "subspace" and params.block_size < 1:
+        raise ValueError(
+            f"block_size must be >= 1, got {params.block_size}")
+
+
+def block_starts(rank: int, block_size: int) -> Tuple[int, ...]:
+    """Static start offsets of the rank blocks one subspace sweep solves.
+
+    Blocks are `block_size` wide; when rank is not divisible the LAST
+    block is shifted left to end at `rank` (so it overlaps its
+    predecessor instead of shrinking — every block keeps one static b x b
+    shape, and re-solving the overlap columns is still exact coordinate
+    descent). rank <= block_size degrades to one block == the full solve.
+    """
+    b = max(1, min(block_size, rank))
+    return tuple(sorted({min(s, rank - b) for s in range(0, rank, b)}))
 
 
 # ---------------------------------------------------------------------------
@@ -353,13 +393,155 @@ def _half_sweep(opposite: jax.Array, row_tgt, row_seg, row_val, row_w,
         alpha_is_zero=(params.alpha == 0), chunk_rows=chunk_rows)
 
 
+def _global_gram(opposite: jax.Array, axis: Optional[str],
+                 n_shards: int) -> jax.Array:
+    """The K x K Gramian of the full opposite factor matrix, computed ONCE
+    per half-sweep (the implicit solver's V^T V term). On a mesh the
+    contraction is SHARDED: each device reduces its slice of the
+    (replicated) rows and one psum of the tiny [K, K] result combines —
+    the ALX sharded-Gramian layout (arXiv:2112.02194)."""
+    if axis is None or n_shards <= 1:
+        return opposite.T @ opposite
+    f, k = opposite.shape
+    per = -(-f // n_shards)
+    op = jnp.pad(opposite, ((0, per * n_shards - f), (0, 0)))
+    i = jax.lax.axis_index(axis)
+    sl = jax.lax.dynamic_slice(op, (i * per, 0), (per, k))
+    return jax.lax.psum(sl.T @ sl, axis)
+
+
+def _half_sweep_subspace_dyn(x_prev: jax.Array, opposite: jax.Array,
+                             row_tgt, row_seg, row_val, row_w,
+                             seg_per_shard: int, *, reg, alpha,
+                             implicit_prefs: bool, weighted_reg: bool,
+                             alpha_is_zero: bool, chunk_rows: int,
+                             block_size: int, axis: Optional[str] = None,
+                             mesh_shards: int = 1) -> jax.Array:
+    """Block coordinate descent half-sweep (iALS++, arXiv:2110.14044).
+
+    Instead of one K x K normal-equations solve per row, sweep rank
+    blocks of width b: for each block, solve every row's b x b system
+    against the frozen remainder of its own factors (``x_prev``, updated
+    block by block), with the per-rating predictions maintained
+    incrementally. Per-half-sweep cost drops from
+    ``nnz*K^2 + S*K^3`` to ``nnz*K*b + S*K*b^2`` — and the batched
+    Cholesky shrinks from [S, K, K] (whose K-step recurrence rewrites
+    the whole buffer every step, the HBM-bandwidth wall at K >= 64) to
+    [S, b, b].
+
+    Cached once per half-sweep and reused by every block solve: the
+    per-segment weight counts (the ALS-WR lambda scaling) and, for
+    implicit feedback, the global Gramian V^T V (sharded over the mesh
+    via `_global_gram`) whose b-column slices feed each block. ``reg`` /
+    ``alpha`` may be traced (the eval sweep vmaps them); only
+    block_size and the mode flags shape the program.
+    """
+    k = opposite.shape[1]
+    b = max(1, min(block_size, k))
+    starts = block_starts(k, block_size)
+    # block buffers are [C, L, b] vs the full path's [C, L, K]: larger
+    # chunks for the same memory budget -> fewer scan steps
+    chunk_b = chunk_rows * max(1, k // b)
+
+    # ---- per-half-sweep cache: built once, reused by every block solve
+    cnt = segment_count(row_seg, row_w.sum(axis=1), seg_per_shard)
+    lam = reg * jnp.where(weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
+    if implicit_prefs:
+        gram_all = _global_gram(opposite, axis, mesh_shards)   # [K, K]
+        p = jnp.where(row_val > 0, 1.0, 0.0)
+        if alpha_is_zero:
+            # c = 1 everywhere: the per-rating Gramian term vanishes
+            gram_w = jnp.zeros_like(row_w)
+            rhs_val = row_w * p
+        else:
+            cm1 = alpha * jnp.abs(row_val)                     # c - 1
+            gram_w = row_w * cm1
+            rhs_val = row_w * (1.0 + cm1) * p
+    else:
+        gram_all = None
+        gram_w = row_w
+        rhs_val = row_w * row_val
+
+    pred = row_predict_add(
+        opposite, x_prev, row_tgt, row_seg,
+        jnp.zeros_like(row_val), chunk_rows=chunk_rows)
+    eye_b = jnp.eye(b, dtype=opposite.dtype)
+
+    x = x_prev
+    for j, s in enumerate(starts):
+        f_b = jax.lax.slice_in_dim(opposite, s, s + b, axis=1)
+        x_b = jax.lax.slice_in_dim(x, s, s + b, axis=1)
+        gram, rhs = block_gram_rhs(
+            f_b, x_b, row_tgt, row_seg, pred, rhs_val, gram_w,
+            num_segments=seg_per_shard, chunk_rows=chunk_b)
+        if implicit_prefs:
+            # dense all-items term from the CACHED global Gramian:
+            # A += G[B,B]; rhs -= (x G)[:,B] - x_B G[B,B]
+            g_col = jax.lax.slice_in_dim(gram_all, s, s + b, axis=1)
+            g_bb = jax.lax.slice_in_dim(g_col, s, s + b, axis=0)
+            gram = gram + g_bb[None, :, :]
+            rhs = rhs - (x @ g_col - x_b @ g_bb)
+        A = gram + lam[:, None, None] * eye_b
+        y = batched_spd_solve(A, rhs)
+        if j + 1 < len(starts):
+            # fold this block's delta into the running predictions (the
+            # LAST block's update feeds nothing, so skip its pass)
+            pred = row_predict_add(f_b, y - x_b, row_tgt, row_seg, pred,
+                                   chunk_rows=chunk_b)
+        x = jax.lax.dynamic_update_slice_in_dim(x, y, s, axis=1)
+    return x
+
+
 def _make_sweeps(mesh: Mesh, data_dims, params: ALSParams):
-    """Build the shard_map'd user/item half-sweeps for the given mesh."""
+    """Build the shard_map'd user/item half-sweeps for the given mesh.
+
+    The full solver's sweeps take (opposite, rows...); the subspace
+    solver's additionally take this side's PREVIOUS factors — sharded
+    like the output, since block coordinate descent updates rank blocks
+    of each shard's own rows against the frozen remainder."""
     from predictionio_tpu.parallel.compat import shard_map
 
-    n_users_pad, n_items_pad, ups, ips = data_dims
+    validate_solver(params)
+    n_users_pad, n_items_pad, ups, ips = data_dims[:4]
     axis = "data"
     chunk = params.chunk_size
+    n_shards = int(mesh.devices.size)
+
+    # check_vma=False: the generic row kernel mixes replicated factor
+    # inputs with device-varying row chunks inside lax.scan; correctness is
+    # covered by the single-vs-8-device equivalence test
+    row_spec = P(axis, None, None)
+    seg_spec = P(axis, None)
+
+    if params.solver == "subspace":
+        def sub_kwargs():
+            return dict(
+                reg=params.reg, alpha=params.alpha,
+                implicit_prefs=params.implicit_prefs,
+                weighted_reg=params.weighted_reg,
+                alpha_is_zero=(params.alpha == 0), chunk_rows=chunk,
+                block_size=params.block_size, axis=axis,
+                mesh_shards=n_shards)
+
+        def user_block(Up, V, tgt, seg, val, w):
+            return _half_sweep_subspace_dyn(
+                Up[0], V, tgt[0], seg[0], val[0], w[0], ups,
+                **sub_kwargs())[None]
+
+        def item_block(Vp, U, tgt, seg, val, w):
+            return _half_sweep_subspace_dyn(
+                Vp[0], U, tgt[0], seg[0], val[0], w[0], ips,
+                **sub_kwargs())[None]
+
+        specs = (P(axis, None, None), P(), row_spec, seg_spec,
+                 row_spec, row_spec)
+        user_sweep = shard_map(
+            user_block, mesh=mesh, in_specs=specs,
+            out_specs=P(axis, None, None), check_vma=False)
+        item_sweep = shard_map(
+            item_block, mesh=mesh, in_specs=specs,
+            out_specs=P(axis, None, None), check_vma=False)
+        return user_sweep, item_sweep
 
     def user_block(V, tgt, seg, val, w):
         # one shard: [1, R, L] row blocks -> local users [ups, K]
@@ -368,11 +550,6 @@ def _make_sweeps(mesh: Mesh, data_dims, params: ALSParams):
     def item_block(U, tgt, seg, val, w):
         return _half_sweep(U, tgt[0], seg[0], val[0], w[0], ips, params, chunk)[None]
 
-    # check_vma=False: the generic row kernel mixes replicated factor
-    # inputs with device-varying row chunks inside lax.scan; correctness is
-    # covered by the single-vs-8-device equivalence test
-    row_spec = P(axis, None, None)
-    seg_spec = P(axis, None)
     user_sweep = shard_map(
         user_block, mesh=mesh,
         in_specs=(P(), row_spec, seg_spec, row_spec, row_spec),
@@ -388,22 +565,41 @@ def _make_chunk_core(mesh: Mesh, data_dims, params: ALSParams, iters: int):
     """Shared iteration body: (by_user, by_item, V) -> (U, V) after `iters`
     alternating sweeps. Both the straight and the checkpointed paths run
     exactly this, so they cannot drift apart."""
-    n_users_pad, n_items_pad, _, _ = data_dims
+    n_users_pad, n_items_pad, ups, ips = data_dims[:4]
     k = params.rank
+    n_shards = n_users_pad // ups
     user_sweep, item_sweep = _make_sweeps(mesh, data_dims, params)
+    subspace = params.solver == "subspace"
 
-    def chunk(by_user, by_item, V):
+    def chunk(by_user, by_item, U, V):
+        # U rides the chunk boundary: the full solver's first user sweep
+        # overwrites it (so a zero U is merely conventional there), but
+        # the subspace solver REFINES it — dropping it between
+        # checkpointing chunks would cold-restart block descent per chunk
+        # and make results depend on checkpointer.interval
         u_tgt, u_seg, u_val, u_w = by_user
         i_tgt, i_seg, i_val, i_w = by_item
 
         def body(_, carry):
             U, V = carry
-            U = user_sweep(V, u_tgt, u_seg, u_val, u_w).reshape(n_users_pad, k)
-            V = item_sweep(U, i_tgt, i_seg, i_val, i_w).reshape(n_items_pad, k)
+            if subspace:
+                # block coordinate descent refines each side's factors in
+                # place: the previous values flow in sharded alongside
+                # the (replicated) opposite side
+                U = user_sweep(U.reshape(n_shards, ups, k), V,
+                               u_tgt, u_seg, u_val, u_w
+                               ).reshape(n_users_pad, k)
+                V = item_sweep(V.reshape(n_shards, ips, k), U,
+                               i_tgt, i_seg, i_val, i_w
+                               ).reshape(n_items_pad, k)
+            else:
+                U = user_sweep(V, u_tgt, u_seg, u_val, u_w
+                               ).reshape(n_users_pad, k)
+                V = item_sweep(U, i_tgt, i_seg, i_val, i_w
+                               ).reshape(n_items_pad, k)
             return (U, V)
 
-        U0 = jnp.zeros((n_users_pad, k), jnp.float32)
-        return jax.lax.fori_loop(0, iters, body, (U0, V))
+        return jax.lax.fori_loop(0, iters, body, (U, V))
 
     return chunk
 
@@ -416,56 +612,72 @@ def make_train_fn(mesh: Mesh, data_dims, params: ALSParams):
     factor matrices flow replicated-in / sharded-out; XLA inserts the
     all-gather between half-sweeps (collectives over ICI).
     """
-    _, n_items_pad, _, _ = data_dims
+    n_users_pad, n_items_pad, _, _, n_items = data_dims
     k = params.rank
     chunk = _make_chunk_core(mesh, data_dims, params, params.num_iterations)
 
     def train(by_user, by_item, key):
         V = (jax.random.normal(key, (n_items_pad, k), jnp.float32)
              / jnp.sqrt(jnp.asarray(k, jnp.float32)))
-        return chunk(by_user, by_item, V)
+        # padding item rows start (and stay) zero: random pad rows would
+        # pollute the implicit solvers' global V^T V Gramian — the full
+        # sweep zeroes them exactly on its first item solve, but block
+        # coordinate descent only decays them, and snapshot/resume
+        # truncates at n_items, so nonzero pads would make a resumed run
+        # diverge from the uninterrupted one
+        V = jnp.where((jnp.arange(n_items_pad) < n_items)[:, None], V, 0.0)
+        U0 = jnp.zeros((n_users_pad, k), jnp.float32)
+        return chunk(by_user, by_item, U0, V)
 
     return jax.jit(train)
 
 
 def make_chunk_fn(mesh: Mesh, data_dims, params: ALSParams, iters: int):
-    """Like make_train_fn but runs `iters` iterations from a given V —
-    the unit of mid-training checkpointing (train_als drives the outer
-    loop, snapshotting V between chunks)."""
+    """Like make_train_fn but runs `iters` iterations from a given
+    (U, V) — the unit of mid-training checkpointing (train_als drives
+    the outer loop, snapshotting between chunks; U matters to the
+    subspace solver, which refines it, and is inert to the full solver,
+    whose first sweep overwrites it)."""
     return jax.jit(_make_chunk_core(mesh, data_dims, params, iters))
 
 
-#: memoized jitted train fns — rebuilding the closures on every call would
-#: force a re-trace per training run (FastEvalEngine's compilation-cache
-#: analog; the cache key is everything that shapes the compiled program).
-#: Bounded LRU so long-running servers that retrain on growing data don't
-#: accumulate compiled executables forever.
-_TRAIN_FN_CACHE: "OrderedDict" = None
-_TRAIN_FN_CACHE_MAX = 8
+#: compile-ledger family of the training path: one entry per distinct
+#: (mesh, data dims, hyperparams, chunking) program — for the subspace
+#: solver that means one per (rank, block_size) family on fixed data, the
+#: bound the solver tests assert via `fn_cache.family_keys`
+TRAIN_FAMILY = "als_train"
 
 
 def _cached_train_fn(mesh: Mesh, data_dims, params: ALSParams,
                      chunk_iters: Optional[int] = None):
-    global _TRAIN_FN_CACHE
-    from collections import OrderedDict
+    """Memoized jitted train fns — rebuilding the closures on every call
+    would force a re-trace per training run (FastEvalEngine's
+    compilation-cache analog; the key is everything that shapes the
+    compiled program). Registered in the shared `ops/fn_cache` ledger so
+    training compiles surface as ``pio_jax_compile_total{family=
+    als_train}``, with the same bounded-LRU protection for long-running
+    servers retraining on growing data. Returns (fn, fresh) — fresh
+    meaning this fetch BUILT the fn, so its first dispatch will
+    trace+compile."""
+    from predictionio_tpu.ops.fn_cache import family_keys, mesh_cached_fn
 
-    if _TRAIN_FN_CACHE is None:
-        _TRAIN_FN_CACHE = OrderedDict()
-    key = (tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
-           mesh.axis_names, data_dims, dataclasses.astuple(params),
-           chunk_iters)
-    fn = _TRAIN_FN_CACHE.get(key)
-    if fn is None:
+    def build():
         if chunk_iters is None:
-            fn = make_train_fn(mesh, data_dims, params)
-        else:
-            fn = make_chunk_fn(mesh, data_dims, params, chunk_iters)
-        _TRAIN_FN_CACHE[key] = fn
-        while len(_TRAIN_FN_CACHE) > _TRAIN_FN_CACHE_MAX:
-            _TRAIN_FN_CACHE.popitem(last=False)
-    else:
-        _TRAIN_FN_CACHE.move_to_end(key)
-    return fn
+            return make_train_fn(mesh, data_dims, params)
+        return make_chunk_fn(mesh, data_dims, params, chunk_iters)
+
+    # block_size only shapes SUBSPACE programs; normalizing it to 0 for
+    # "full" keeps full-solver trains that merely carry different resolved
+    # block sizes (e.g. a PIO_ALS_BLOCK_SIZE override on a full box) on
+    # ONE compiled program and ONE ledger entry — mirroring the eval
+    # sweep's group_candidates
+    key_params = (dataclasses.replace(params, block_size=0)
+                  if params.solver == "full" else params)
+    key = (data_dims, dataclasses.astuple(key_params), chunk_iters)
+    # a fn fetched fresh has never been dispatched: its first call pays
+    # trace+compile, which the half-sweep timing metric must not count
+    fresh = (mesh, key) not in family_keys(TRAIN_FAMILY)
+    return mesh_cached_fn(TRAIN_FAMILY, mesh, key, build), fresh
 
 
 def _process_shard_range(mesh: Mesh) -> Tuple[int, int]:
@@ -640,7 +852,10 @@ def coo_digest(user_idx: np.ndarray, item_idx: np.ndarray,
 def als_fingerprint(data: ALSData, params: ALSParams) -> str:
     """Identity of a training run for checkpoint-resume safety: math-shaping
     hyperparams (num_iterations/chunk_size excluded — more iterations on the
-    same run IS the resume use case) + dataset stats + the mesh-independent
+    same run IS the resume use case; solver/block_size excluded too — both
+    solvers minimize the same objective and V is the complete state, so a
+    snapshot survives switching solvers mid-run) + dataset stats + the
+    mesh-independent
     COO digest (NOT the padded row layout, which varies with shard count —
     snapshots must survive resuming on a different mesh shape). A crashed
     run restarted with different reg/seed/alpha/implicit_prefs, or against
@@ -666,6 +881,14 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
     (the ALS state is fully determined by V — each sweep recomputes U from
     it); a crashed/preempted run resumes from the latest snapshot, even on
     a different mesh shape (snapshots hold unpadded host arrays)."""
+    import time
+
+    from predictionio_tpu.obs.tracing import span
+    from predictionio_tpu.obs.train_stats import (
+        als_block_sweeps, als_gramian_cache_hits, als_half_sweep_seconds,
+    )
+
+    validate_solver(params)
     n_shards = int(np.prod(mesh.devices.shape))
     assert data.by_user.tgt.shape[0] == n_shards, \
         f"data built for {data.by_user.tgt.shape[0]} shards, mesh has {n_shards}"
@@ -686,20 +909,30 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
         return np.asarray(jax.device_get(arr))[:n_rows]
 
     dims = (data.n_users_pad, data.n_items_pad,
-            data.by_user.seg_per_shard, data.by_item.seg_per_shard)
+            data.by_user.seg_per_shard, data.by_item.seg_per_shard,
+            data.n_items)
     key = jax.random.PRNGKey(params.seed)
     bu = (data.by_user.tgt, data.by_user.seg, data.by_user.val, data.by_user.w)
     bi = (data.by_item.tgt, data.by_item.seg, data.by_item.val, data.by_item.w)
 
+    solve_s = 0.0    # device-dispatch wall only, excluding snapshot I/O
+    compiled = False  # any timed dispatch paid trace+compile
+    iters_run = params.num_iterations
     if checkpointer is None:
-        train = _cached_train_fn(mesh, dims, params)
-        U, V = train(bu, bi, key)
+        train, fresh = _cached_train_fn(mesh, dims, params)
+        compiled |= fresh
+        with span("als_solve"):
+            t0 = time.perf_counter()
+            U, V = train(bu, bi, key)
+            jax.block_until_ready(V)
+            solve_s += time.perf_counter() - t0
     else:
         k = params.rank
         fp = als_fingerprint(data, params)
         snap = checkpointer.latest(fingerprint=fp)
         it = 0
         V = None
+        U = None     # subspace snapshots carry U too (BCD state is (U, V))
         if multihost:
             # the resume decision must be IDENTICAL on every host or the
             # SPMD programs diverge (some resuming, some from scratch);
@@ -711,16 +944,29 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
             ok = snap is not None and snap[1].get("V") is not None \
                 and snap[1]["V"].shape == (data.n_items, k) \
                 and snap[0] < params.num_iterations
-            meta = np.zeros(2, np.int64)
+            # only subspace snapshots carry U; gating on the solver (a
+            # host-uniform static) avoids allocating + broadcasting an
+            # n_users x k zero buffer on every full-solver train start
+            want_u = params.solver == "subspace"
+            has_u = want_u and ok and snap[1].get("U") is not None \
+                and snap[1]["U"].shape == (data.n_users, k)
+            meta = np.zeros(3, np.int64)
             v_buf = np.zeros((data.n_items, k), np.float32)
+            u_buf = (np.zeros((data.n_users, k), np.float32) if want_u
+                     else np.zeros((0, k), np.float32))
             if jax.process_index() == 0 and ok:
-                meta[:] = (1, snap[0])
+                meta[:] = (1, snap[0], int(has_u))
                 v_buf[:] = np.asarray(snap[1]["V"], np.float32)
-            meta, v_buf = broadcast_one_to_all((meta, v_buf))
+                if has_u:
+                    u_buf[:] = np.asarray(snap[1]["U"], np.float32)
+            meta, v_buf, u_buf = broadcast_one_to_all((meta, v_buf, u_buf))
             if int(meta[0]):
                 it = int(meta[1])
                 V = jnp.zeros((data.n_items_pad, k), jnp.float32)
                 V = V.at[:data.n_items].set(jnp.asarray(v_buf))
+                if int(meta[2]):
+                    U = jnp.zeros((data.n_users_pad, k), jnp.float32)
+                    U = U.at[:data.n_users].set(jnp.asarray(u_buf))
         elif snap is not None and snap[1].get("V") is not None \
                 and snap[1]["V"].shape == (data.n_items, k) \
                 and snap[0] < params.num_iterations:
@@ -729,27 +975,68 @@ def train_als(mesh: Mesh, data: ALSData, params: ALSParams,
             it, state = snap
             V = jnp.zeros((data.n_items_pad, k), jnp.float32)
             V = V.at[:data.n_items].set(jnp.asarray(state["V"]))
+            if state.get("U") is not None \
+                    and state["U"].shape == (data.n_users, k):
+                U = jnp.zeros((data.n_users_pad, k), jnp.float32)
+                U = U.at[:data.n_users].set(jnp.asarray(state["U"]))
         if V is None:
             V = (jax.random.normal(key, (data.n_items_pad, k), jnp.float32)
                  / jnp.sqrt(jnp.asarray(k, jnp.float32)))
-        U = jnp.zeros((data.n_users_pad, k), jnp.float32)
+            # same pad-row zeroing as make_train_fn's init: the chunked
+            # run must start from the identical state
+            V = jnp.where((jnp.arange(data.n_items_pad)
+                           < data.n_items)[:, None], V, 0.0)
+        if U is None:
+            U = jnp.zeros((data.n_users_pad, k), jnp.float32)
+        iters_run = params.num_iterations - it
+        # the full solver's state is V alone (each sweep recomputes U
+        # exactly); block coordinate descent refines BOTH sides, so its
+        # snapshots carry U too — resume stays bit-equivalent to the
+        # uninterrupted run
+        snap_u = params.solver == "subspace"
         while it < params.num_iterations:
             n = min(checkpointer.interval, params.num_iterations - it)
-            chunk = _cached_train_fn(mesh, dims, params, chunk_iters=n)
-            U, V = chunk(bu, bi, V)
+            chunk, fresh = _cached_train_fn(mesh, dims, params,
+                                            chunk_iters=n)
+            compiled |= fresh
+            with span("als_solve"):
+                t0 = time.perf_counter()
+                U, V = chunk(bu, bi, U, V)
+                jax.block_until_ready(V)
+                solve_s += time.perf_counter() - t0
             it += n
             if it < params.num_iterations:
                 if multihost:
                     # V is sharded across hosts: snapshot the gathered
                     # copy, and only process 0 writes (every process
                     # writing the same file would race)
-                    v_host = gather_host(V, data.n_items)
+                    state = {"V": gather_host(V, data.n_items)}
+                    if snap_u:
+                        state["U"] = gather_host(U, data.n_users)
                     if jax.process_index() == 0:
-                        checkpointer.save(it, {"V": v_host},
-                                          fingerprint=fp)
+                        checkpointer.save(it, state, fingerprint=fp)
                 else:
-                    checkpointer.save(it, {"V": V[:data.n_items]},
-                                      fingerprint=fp)
+                    state = {"V": V[:data.n_items]}
+                    if snap_u:
+                        state["U"] = U[:data.n_users]
+                    checkpointer.save(it, state, fingerprint=fp)
+
+    # half-sweep accounting (host-side: the sweeps run fused inside one
+    # device loop, so per-sweep numbers are derived, not sampled; only
+    # solve-dispatch wall counts — snapshot gathers/writes between chunks
+    # must not inflate the kernel's timing, and a cold dispatch's
+    # trace+compile would drown the per-solver comparison the histogram
+    # exists for, so compiling runs observe nothing)
+    half_sweeps = max(1, 2 * iters_run)
+    if not compiled:
+        als_half_sweep_seconds().observe(
+            solve_s / half_sweeps, solver=params.solver)
+    if params.solver == "subspace":
+        n_blocks = len(block_starts(params.rank, params.block_size))
+        als_block_sweeps().inc(half_sweeps * n_blocks)
+        # the per-half-sweep Gramian/count cache serves every block solve
+        # after the first without a rebuild
+        als_gramian_cache_hits().inc(half_sweeps * max(0, n_blocks - 1))
     return gather_host(U, data.n_users), gather_host(V, data.n_items)
 
 

@@ -40,7 +40,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from predictionio_tpu.models.als import (
-    ALSParams, _auto_row_len, _half_sweep_dyn, _row_positions,
+    ALSParams, _auto_row_len, _half_sweep_dyn, _half_sweep_subspace_dyn,
+    _row_positions, validate_solver,
 )
 from predictionio_tpu.obs.eval_stats import (
     eval_batch_size, eval_candidates_counter, eval_compile_groups,
@@ -154,29 +155,39 @@ def build_sweep_data(user_idx: np.ndarray, item_idx: np.ndarray,
 class GroupStatic:
     """Everything that shapes the compiled program. Candidates differing
     only in reg/alpha/seed/num_iterations share a group (and a compile);
-    each distinct rank is its own group."""
+    each distinct (rank, solver, block_size) family is its own group —
+    the compile-ledger bound the tests assert."""
 
     rank: int
     implicit_prefs: bool
     weighted_reg: bool
     alpha_is_zero: bool
     chunk_size: int
+    solver: str = "full"
+    block_size: int = 0     # 0 for the full solver (no block structure)
 
     @property
     def label(self) -> str:
         return f"rank={self.rank}" + \
-            ("/implicit" if self.implicit_prefs else "")
+            ("/implicit" if self.implicit_prefs else "") + \
+            (f"/sub{self.block_size}" if self.solver == "subspace" else "")
 
 
 def group_candidates(candidates: Sequence[ALSParams]
                      ) -> "OrderedDict[GroupStatic, List[int]]":
     groups: "OrderedDict[GroupStatic, List[int]]" = OrderedDict()
     for i, p in enumerate(candidates):
+        validate_solver(p)
         key = GroupStatic(
             rank=int(p.rank), implicit_prefs=bool(p.implicit_prefs),
             weighted_reg=bool(p.weighted_reg),
             alpha_is_zero=bool(p.implicit_prefs and p.alpha == 0),
-            chunk_size=int(p.chunk_size))
+            chunk_size=int(p.chunk_size),
+            solver=str(p.solver),
+            # block_size only shapes subspace programs; normalizing it to
+            # 0 for "full" keeps full-solver candidates in ONE group no
+            # matter what block_size they happen to carry
+            block_size=(int(p.block_size) if p.solver == "subspace" else 0))
         groups.setdefault(key, []).append(i)
     return groups
 
@@ -223,20 +234,36 @@ def _build_train_fn(static: GroupStatic, n_users: int, n_items: int,
 
             def body(i, carry):
                 U, V = carry
-                U2 = _half_sweep_dyn(
-                    V, u_tgt, u_seg, u_val, uw, n_users,
-                    reg=reg, alpha=alpha,
-                    implicit_prefs=static.implicit_prefs,
-                    weighted_reg=static.weighted_reg,
-                    alpha_is_zero=static.alpha_is_zero,
-                    chunk_rows=chunk_u)
-                V2 = _half_sweep_dyn(
-                    U2, i_tgt, i_seg, i_val, iw, n_items,
-                    reg=reg, alpha=alpha,
-                    implicit_prefs=static.implicit_prefs,
-                    weighted_reg=static.weighted_reg,
-                    alpha_is_zero=static.alpha_is_zero,
-                    chunk_rows=chunk_i)
+                if static.solver == "subspace":
+                    U2 = _half_sweep_subspace_dyn(
+                        U, V, u_tgt, u_seg, u_val, uw, n_users,
+                        reg=reg, alpha=alpha,
+                        implicit_prefs=static.implicit_prefs,
+                        weighted_reg=static.weighted_reg,
+                        alpha_is_zero=static.alpha_is_zero,
+                        chunk_rows=chunk_u, block_size=static.block_size)
+                    V2 = _half_sweep_subspace_dyn(
+                        V, U2, i_tgt, i_seg, i_val, iw, n_items,
+                        reg=reg, alpha=alpha,
+                        implicit_prefs=static.implicit_prefs,
+                        weighted_reg=static.weighted_reg,
+                        alpha_is_zero=static.alpha_is_zero,
+                        chunk_rows=chunk_i, block_size=static.block_size)
+                else:
+                    U2 = _half_sweep_dyn(
+                        V, u_tgt, u_seg, u_val, uw, n_users,
+                        reg=reg, alpha=alpha,
+                        implicit_prefs=static.implicit_prefs,
+                        weighted_reg=static.weighted_reg,
+                        alpha_is_zero=static.alpha_is_zero,
+                        chunk_rows=chunk_u)
+                    V2 = _half_sweep_dyn(
+                        U2, i_tgt, i_seg, i_val, iw, n_items,
+                        reg=reg, alpha=alpha,
+                        implicit_prefs=static.implicit_prefs,
+                        weighted_reg=static.weighted_reg,
+                        alpha_is_zero=static.alpha_is_zero,
+                        chunk_rows=chunk_i)
                 # units may carry fewer iterations than the group max:
                 # finished units freeze their factors
                 keep = i < iters_n

@@ -30,6 +30,27 @@ def segment_count(seg_idx: jax.Array, weights: jax.Array,
     return jnp.zeros((num_segments,), weights.dtype).at[seg_idx].add(weights)
 
 
+def _pad_rows_sorted(row_tgt, row_seg, extra, num_segments, chunk_rows):
+    """Pad padded-row inputs to a chunk multiple. Padding rows aim at the
+    LAST segment (keeps row_seg sorted) and every `extra` array is padded
+    with zeros (weight-0 rows contribute nothing). Returns the padded
+    (row_tgt, row_seg, *extra) plus the chunk count."""
+    r, l = row_tgt.shape
+    chunk = min(chunk_rows, max(r, 1))
+    num_chunks = max(1, (r + chunk - 1) // chunk)
+    padded = num_chunks * chunk
+    if padded != r:
+        pad = padded - r
+        row_tgt = jnp.concatenate(
+            [row_tgt, jnp.zeros((pad, l), row_tgt.dtype)])
+        row_seg = jnp.concatenate(
+            [row_seg, jnp.full((pad,), num_segments - 1, row_seg.dtype)])
+        extra = tuple(
+            jnp.concatenate([a, jnp.zeros((pad, l), a.dtype)])
+            for a in extra)
+    return row_tgt, row_seg, extra, num_chunks, chunk
+
+
 @functools.partial(
     jax.jit, static_argnames=("num_segments", "chunk_rows"))
 def rows_gram_rhs(
@@ -51,20 +72,11 @@ def rows_gram_rhs(
     Returns (gram [S, K, K], rhs [S, K], count [S]).
     """
     k = factors.shape[-1]
-    r, l = row_tgt.shape
-    chunk_rows = min(chunk_rows, max(r, 1))  # never pad past the real rows
-    num_chunks = max(1, (r + chunk_rows - 1) // chunk_rows)
-    padded = num_chunks * chunk_rows
-    if padded != r:
-        pad = padded - r
-        # weight-0 rows aimed at the LAST segment keep row_seg sorted
-        row_tgt = jnp.concatenate(
-            [row_tgt, jnp.zeros((pad, l), row_tgt.dtype)])
-        row_seg = jnp.concatenate(
-            [row_seg, jnp.full((pad,), num_segments - 1, row_seg.dtype)])
-        row_val = jnp.concatenate(
-            [row_val, jnp.zeros((pad, l), row_val.dtype)])
-        row_w = jnp.concatenate([row_w, jnp.zeros((pad, l), row_w.dtype)])
+    l = row_tgt.shape[1]
+    # weight-0 rows aimed at the LAST segment keep row_seg sorted
+    row_tgt, row_seg, (row_val, row_w), num_chunks, chunk_rows = \
+        _pad_rows_sorted(row_tgt, row_seg, (row_val, row_w),
+                         num_segments, chunk_rows)
 
     tgt_c = row_tgt.reshape(num_chunks, chunk_rows, l)
     seg_c = row_seg.reshape(num_chunks, chunk_rows)
@@ -89,3 +101,96 @@ def rows_gram_rhs(
     (gram, rhs, count), _ = jax.lax.scan(
         body, init, (tgt_c, seg_c, val_c, w_c))
     return gram, rhs, count
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_rows",))
+def row_predict_add(
+    factors: jax.Array,     # [F, B] factor columns indexed by row_tgt
+    x_rows: jax.Array,      # [S, B] this side's factor columns per segment
+    row_tgt: jax.Array,     # [R, L]
+    row_seg: jax.Array,     # [R]
+    row_pred: jax.Array,    # [R, L] running prediction (0 to initialize)
+    chunk_rows: int = 8192,
+) -> jax.Array:
+    """row_pred + <x_rows[seg], factors[tgt]> per rating slot.
+
+    The residual-maintenance primitive of the subspace ALS solver: called
+    with the full factor matrices it initializes each rating's predicted
+    value; called with a single block's columns and the block DELTA it
+    folds one block update into the running prediction without touching
+    the other rank coordinates.
+    """
+    r, l = row_tgt.shape
+    row_tgt, row_seg, _, num_chunks, chunk = _pad_rows_sorted(
+        row_tgt, row_seg, (), x_rows.shape[0], chunk_rows)
+    tgt_c = row_tgt.reshape(num_chunks, chunk, l)
+    seg_c = row_seg.reshape(num_chunks, chunk)
+
+    def body(_, sl):
+        tgt, seg = sl
+        f = factors[tgt]                                  # [C, L, B]
+        return None, jnp.einsum("clb,cb->cl", f, x_rows[seg])
+
+    _, pred = jax.lax.scan(body, None, (tgt_c, seg_c))
+    return row_pred + pred.reshape(num_chunks * chunk, l)[:r]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_segments", "chunk_rows"))
+def block_gram_rhs(
+    factors_b: jax.Array,   # [F, B] ONE rank block's factor columns
+    x_b: jax.Array,         # [S, B] this side's current block columns
+    row_tgt: jax.Array,     # [R, L]
+    row_seg: jax.Array,     # [R] (sorted)
+    row_pred: jax.Array,    # [R, L] full current prediction per rating
+    rhs_val: jax.Array,     # [R, L] rhs weight*value per rating
+    gram_w: jax.Array,      # [R, L] Gramian weights (0 = padding)
+    num_segments: int,
+    chunk_rows: int = 8192,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-segment b x b normal equations of one rank-subspace block.
+
+    The block-coordinate-descent analog of `rows_gram_rhs` (iALS++,
+    arXiv:2110.14044): with the other rank coordinates frozen at their
+    current values, each segment's optimal block solves
+
+        (sum_j gram_w_j f_j f_j^T + reg I) y =
+            sum_j (rhs_val_j - gram_w_j * (pred_j - <f_j, x_b>)) f_j
+
+    where ``pred - <f_b, x_b>`` is the prediction with this block's own
+    contribution removed. Explicit feedback passes ``gram_w = w`` and
+    ``rhs_val = w * rating``; implicit (Hu-Koren-Volinsky) passes
+    ``gram_w = w * (c-1)`` and ``rhs_val = w * c * p`` (the global
+    Gramian term is added by the caller from the cached V^T V). The
+    gather/matmul buffers are [C, L, b] instead of [C, L, K] — the
+    bandwidth saving that makes the O(r * b^2) per-row sweep pay.
+    Returns (gram [S, b, b], rhs [S, b]).
+    """
+    b = factors_b.shape[-1]
+    l = row_tgt.shape[1]
+    row_tgt, row_seg, (row_pred, rhs_val, gram_w), num_chunks, chunk = \
+        _pad_rows_sorted(row_tgt, row_seg, (row_pred, rhs_val, gram_w),
+                         num_segments, chunk_rows)
+    tgt_c = row_tgt.reshape(num_chunks, chunk, l)
+    seg_c = row_seg.reshape(num_chunks, chunk)
+    pred_c = row_pred.reshape(num_chunks, chunk, l)
+    val_c = rhs_val.reshape(num_chunks, chunk, l)
+    w_c = gram_w.reshape(num_chunks, chunk, l)
+
+    def body(carry, sl):
+        gram, rhs = carry
+        tgt, seg, pred, val, w = sl
+        f = factors_b[tgt]                                # [C, L, b]
+        pred_b = jnp.einsum("clb,cb->cl", f, x_b[seg])    # block's own part
+        fw = f * w[..., None]
+        gram_rows = jnp.einsum("clb,cln->cbn", fw, f)     # batched MXU matmul
+        rhs_rows = jnp.einsum("clb,cl->cb", f, val - w * (pred - pred_b))
+        gram = gram.at[seg].add(gram_rows, indices_are_sorted=True)
+        rhs = rhs.at[seg].add(rhs_rows, indices_are_sorted=True)
+        return (gram, rhs), None
+
+    init = (jnp.zeros((num_segments, b, b), factors_b.dtype),
+            jnp.zeros((num_segments, b), factors_b.dtype))
+    (gram, rhs), _ = jax.lax.scan(
+        body, init, (tgt_c, seg_c, pred_c, val_c, w_c))
+    return gram, rhs

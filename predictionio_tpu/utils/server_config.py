@@ -18,7 +18,8 @@ Config file: ``$PIO_CONF_DIR/server.json`` (or the path in
      "deploy": {"warmup": true, "canaryFraction": 0.1, "canaryWindow": 200,
                 "canaryPromoteAfter": 100, "canaryP99Ratio": 2.0},
      "ingest": {"maxEventsPerBatch": 50, "buffer": true, "queueMax": 8192,
-                "flushMax": 256, "lingerS": 0.002, "retries": 4}}
+                "flushMax": 256, "lingerS": 0.002, "retries": 4},
+     "train": {"alsSolver": "subspace", "alsBlockSize": 16}}
 
 All fields optional; env vars ``PIO_SERVER_KEY`` / ``PIO_SSL_CERTFILE`` /
 ``PIO_SSL_KEYFILE`` override file values, as do the serving-tuning knobs
@@ -178,6 +179,131 @@ class IngestConfig:
 
 
 @dataclasses.dataclass
+class TrainConfig:
+    """Training-kernel tuning (server.json ``train`` section, camelCase
+    keys; ``PIO_ALS_*`` env overrides).
+
+    ``als_solver`` selects the ALS training solver for every ALS-backed
+    engine: ``"full"`` (per-row K x K normal equations, the classic
+    sweep) or ``"subspace"`` (iALS++ block coordinate descent over rank
+    blocks of ``als_block_size`` — the high-rank fast path, README
+    "Training kernel"). ``None`` means no host-level preference: the
+    engine's own algo params (or the built-in default, "full") decide.
+    Precedence, strongest first: ``PIO_ALS_SOLVER`` / ``PIO_ALS_BLOCK_SIZE``
+    env (the operator flipping a box without editing engine.json) >
+    engine.json algo params ``"solver"`` section > this file section >
+    defaults.
+    """
+
+    als_solver: Optional[str] = None       # None | "full" | "subspace"
+    als_block_size: Optional[int] = None   # None = solver default (16)
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None) -> "TrainConfig":
+        """server.json ``train`` section overlaid by env vars (env wins);
+        malformed knobs are logged and fall back, same contract as
+        ServingConfig."""
+        data = data or {}
+        cfg = cls()
+
+        def as_solver(v):
+            s = str(v).strip().lower()
+            if s not in ("full", "subspace"):
+                raise ValueError(s)
+            return s
+
+        sources = (
+            ("alsSolver", data.get("alsSolver"), "als_solver", as_solver),
+            ("alsBlockSize", data.get("alsBlockSize"), "als_block_size",
+             int),
+            ("PIO_ALS_SOLVER", os.environ.get("PIO_ALS_SOLVER"),
+             "als_solver", as_solver),
+            ("PIO_ALS_BLOCK_SIZE", os.environ.get("PIO_ALS_BLOCK_SIZE"),
+             "als_block_size", int),
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed train knob %s=%r",
+                               name, raw)
+        if cfg.als_block_size is not None:
+            cfg.als_block_size = max(1, cfg.als_block_size)
+        return cfg
+
+
+DEFAULT_ALS_BLOCK_SIZE = 16
+
+
+def als_solver_config(algo_solver: Optional[dict] = None,
+                      config: Optional[TrainConfig] = None
+                      ) -> "tuple[str, int]":
+    """Resolve the (solver_mode, block_size) an ALS train should use.
+
+    ``algo_solver`` is the engine.json algo-params ``"solver"`` section
+    (``{"mode": "full"|"subspace", "block_size": N}``), which overrides
+    the host-level server.json ``train`` section; ``PIO_ALS_SOLVER`` /
+    ``PIO_ALS_BLOCK_SIZE`` env vars override both. A malformed env/file
+    value is logged and ignored (a bad knob must never stop a train), but
+    a bad mode WRITTEN IN the engine variant raises — that is the user's
+    explicit config, not an environment overlay.
+    """
+    if config is None:
+        # the host-level default LIVES in server.json: resolve the train
+        # section (env already overlaid by from_env) so an operator's
+        # {"train": {...}} applies to every ALS train on the box
+        config = ServerConfig.load().train
+    if isinstance(algo_solver, str):
+        # accept the natural shorthand "solver": "subspace" (the knob is
+        # a bare string everywhere else, e.g. PIO_ALS_SOLVER)
+        algo_solver = {"mode": algo_solver}
+    elif algo_solver is not None and not isinstance(algo_solver, dict):
+        raise ValueError(
+            f"algo params solver must be a mode string or a "
+            f'{{"mode", "block_size"}} object, got '
+            f"{type(algo_solver).__name__}")
+    mode, block = "full", None   # per-KNOB fallback chain, not per-section
+    algo_mode = None
+    if algo_solver:
+        if "mode" in algo_solver:
+            algo_mode = str(algo_solver["mode"]).strip().lower()
+            if algo_mode not in ("full", "subspace"):
+                raise ValueError(
+                    f'algo params solver.mode {algo_mode!r}: expected '
+                    f'"full" or "subspace"')
+            mode = algo_mode
+        raw = algo_solver.get("block_size",
+                              algo_solver.get("blockSize"))
+        if raw is not None:
+            block = max(1, int(raw))
+        unknown = set(algo_solver) - {"mode", "block_size", "blockSize"}
+        if unknown:
+            raise ValueError(
+                f"unknown solver params {sorted(unknown)}: expected "
+                f"mode/block_size")
+    if algo_mode is None and config.als_solver is not None:
+        # per-knob: a section that tunes only block_size still inherits
+        # the operator's host-level mode preference
+        mode = config.als_solver
+    if block is None and config.als_block_size is not None:
+        # an algo section that names only a mode still inherits the
+        # operator's host-level block-size tuning
+        block = config.als_block_size
+    if block is None:
+        block = DEFAULT_ALS_BLOCK_SIZE
+    # env beats everything (resolved again here so callers that pass a
+    # file-built TrainConfig still honor the operator override)
+    env_cfg = TrainConfig.from_env(None)
+    if env_cfg.als_solver is not None:
+        mode = env_cfg.als_solver
+    if env_cfg.als_block_size is not None:
+        block = env_cfg.als_block_size
+    return mode, block
+
+
+@dataclasses.dataclass
 class DeployConfig:
     """Deploy-lifecycle tuning (the ``PIO_DEPLOY_*`` / ``PIO_CANARY_*``
     knobs; server.json ``deploy`` section, camelCase keys).
@@ -268,6 +394,7 @@ class ServerConfig:
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     deploy: DeployConfig = dataclasses.field(default_factory=DeployConfig)
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "ServerConfig":
@@ -293,6 +420,7 @@ class ServerConfig:
             serving=ServingConfig.from_env(data.get("serving") or {}),
             deploy=DeployConfig.from_env(data.get("deploy") or {}),
             ingest=IngestConfig.from_env(data.get("ingest") or {}),
+            train=TrainConfig.from_env(data.get("train") or {}),
         )
         if os.environ.get("PIO_SERVER_KEY"):
             cfg.key = os.environ["PIO_SERVER_KEY"]

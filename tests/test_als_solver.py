@@ -1,0 +1,426 @@
+"""Subspace (iALS++ block coordinate descent) ALS solver correctness.
+
+The tentpole contracts under test:
+  * randomized full-vs-subspace convergence — same data, equal outer
+    iterations, train RMSE within tolerance (and block_size >= rank
+    degrades to EXACTLY the full solve);
+  * the als_train compile ledger is bounded by distinct
+    (rank, block_size) families, not by train calls;
+  * deterministic under seed (bitwise-identical factors across runs);
+  * the degenerate block case (rank not divisible by block_size) solves
+    via the shifted overlapping last block;
+  * sharded (8-device) subspace training matches single-device;
+  * solver selection knobs resolve with the documented precedence.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.als import (
+    ALSData, ALSParams, block_starts, rmse, train_als, validate_solver,
+)
+from predictionio_tpu.utils.server_config import als_solver_config
+
+
+def synthetic_ratings(n_users=60, n_items=40, rank=4, density=0.5, seed=0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n_users, rank)).astype(np.float32)
+    V = rng.normal(size=(n_items, rank)).astype(np.float32)
+    full = U @ V.T
+    mask = rng.random((n_users, n_items)) < density
+    users, items = np.nonzero(mask)
+    return (users.astype(np.int32), items.astype(np.int32),
+            full[users, items].astype(np.float32), n_users, n_items)
+
+
+def single_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:1]), axis_names=("data",))
+
+
+# ---------------------------------------------------------------------------
+# Block geometry
+# ---------------------------------------------------------------------------
+
+def test_block_starts_divisible_and_degenerate():
+    assert block_starts(8, 4) == (0, 4)
+    assert block_starts(16, 16) == (0,)
+    # rank not divisible: the LAST block shifts left to end at rank
+    assert block_starts(10, 4) == (0, 4, 6)
+    assert block_starts(7, 3) == (0, 3, 4)
+    # block >= rank degrades to one full-width block
+    assert block_starts(6, 64) == (0,)
+    assert block_starts(5, 5) == (0,)
+
+
+def test_validate_solver_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown ALS solver"):
+        validate_solver(ALSParams(solver="fancy"))
+    with pytest.raises(ValueError, match="block_size"):
+        validate_solver(ALSParams(solver="subspace", block_size=0))
+    validate_solver(ALSParams(solver="subspace", block_size=4))
+
+
+def test_train_rejects_unknown_solver():
+    users, items, ratings, nu, ni = synthetic_ratings()
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    with pytest.raises(ValueError, match="unknown ALS solver"):
+        train_als(single_mesh(), data, ALSParams(solver="typo"))
+
+
+# ---------------------------------------------------------------------------
+# Convergence parity (randomized)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [1, 11, 21])
+def test_subspace_converges_with_full_explicit(seed):
+    users, items, ratings, nu, ni = synthetic_ratings(seed=seed)
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    mesh = single_mesh()
+    kw = dict(rank=8, num_iterations=12, reg=0.01, seed=seed,
+              chunk_size=64)
+    Uf, Vf = train_als(mesh, data, ALSParams(**kw))
+    Us, Vs = train_als(mesh, data, ALSParams(
+        **kw, solver="subspace", block_size=4))
+    err_f = rmse(Uf, Vf, users, items, ratings)
+    err_s = rmse(Us, Vs, users, items, ratings)
+    # same data, equal outer iterations: both reconstruct the low-rank
+    # signal, and block coordinate descent lands within tolerance of the
+    # full per-row solve
+    assert err_f < 0.05, err_f
+    assert err_s < 0.08, err_s
+    assert abs(err_s - err_f) < 0.05
+
+
+def test_subspace_block_covering_rank_equals_full_exactly():
+    """block_size >= rank is ONE block over all coordinates — the block
+    solve then IS the full normal-equations solve, so the factors must
+    match the full solver bitwise (the strongest possible parity
+    anchor for the block kernel's math)."""
+    users, items, ratings, nu, ni = synthetic_ratings(seed=3)
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    mesh = single_mesh()
+    kw = dict(rank=8, num_iterations=6, reg=0.02, seed=5, chunk_size=64)
+    Uf, Vf = train_als(mesh, data, ALSParams(**kw))
+    Us, Vs = train_als(mesh, data, ALSParams(
+        **kw, solver="subspace", block_size=32))
+    np.testing.assert_array_equal(Uf, Us)
+    np.testing.assert_array_equal(Vf, Vs)
+
+
+def test_subspace_degenerate_block_rank_not_divisible():
+    users, items, ratings, nu, ni = synthetic_ratings(seed=4)
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    params = ALSParams(rank=10, num_iterations=12, reg=0.01, seed=2,
+                       chunk_size=64, solver="subspace", block_size=4)
+    U, V = train_als(single_mesh(), data, params)
+    assert U.shape == (nu, 10) and V.shape == (ni, 10)
+    err = rmse(U, V, users, items, ratings)
+    assert err < 0.08, f"degenerate-block train RMSE too high: {err}"
+
+
+def test_subspace_implicit_ranks_positives_first():
+    rng = np.random.default_rng(5)
+    nu, ni = 30, 20
+    users, items, counts = [], [], []
+    for u in range(nu):
+        group = u % 2
+        for it in range(ni):
+            if (it % 2) == group and rng.random() < 0.8:
+                users.append(u)
+                items.append(it)
+                counts.append(rng.integers(1, 5))
+    users = np.array(users, np.int32)
+    items = np.array(items, np.int32)
+    counts = np.array(counts, np.float32)
+    data = ALSData.build(users, items, counts, nu, ni, n_shards=1)
+    params = ALSParams(rank=8, num_iterations=10, reg=0.1, alpha=10.0,
+                       implicit_prefs=True, seed=0, chunk_size=64,
+                       solver="subspace", block_size=4)
+    U, V = train_als(single_mesh(), data, params)
+    scores = U @ V.T
+    even = scores[0, 0::2].mean()
+    odd = scores[0, 1::2].mean()
+    assert even > odd + 0.1
+
+
+def test_subspace_implicit_full_block_matches_full_solver():
+    """Implicit parity anchor: one block over all coordinates must
+    reproduce the full implicit solve (the cached global Gramian + block
+    correction algebra collapses to V^T V + per-rating terms)."""
+    users, items, ratings, nu, ni = synthetic_ratings(seed=6)
+    counts = np.abs(ratings) + 0.5
+    data = ALSData.build(users, items, counts, nu, ni, n_shards=1)
+    mesh = single_mesh()
+    kw = dict(rank=6, num_iterations=6, reg=0.1, alpha=3.0,
+              implicit_prefs=True, seed=1, chunk_size=64)
+    Uf, Vf = train_als(mesh, data, ALSParams(**kw))
+    Us, Vs = train_als(mesh, data, ALSParams(
+        **kw, solver="subspace", block_size=6))
+    np.testing.assert_allclose(Uf, Us, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(Vf, Vs, rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Determinism + sharding
+# ---------------------------------------------------------------------------
+
+def test_subspace_deterministic_under_seed():
+    users, items, ratings, nu, ni = synthetic_ratings(seed=7)
+    params = ALSParams(rank=8, num_iterations=5, reg=0.05, seed=9,
+                       chunk_size=64, solver="subspace", block_size=4)
+    mesh = single_mesh()
+    d1 = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    U1, V1 = train_als(mesh, d1, params)
+    U2, V2 = train_als(mesh, d1, params)
+    np.testing.assert_array_equal(U1, U2)
+    np.testing.assert_array_equal(V1, V2)
+    # a different seed genuinely changes the result
+    U3, _ = train_als(mesh, d1, ALSParams(
+        rank=8, num_iterations=5, reg=0.05, seed=10, chunk_size=64,
+        solver="subspace", block_size=4))
+    assert not np.array_equal(U1, U3)
+
+
+def test_subspace_sharded_matches_single(mesh8):
+    users, items, ratings, nu, ni = synthetic_ratings(seed=2)
+    params = ALSParams(rank=6, num_iterations=5, reg=0.05, seed=4,
+                       chunk_size=64, solver="subspace", block_size=2)
+    d1 = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    U1, V1 = train_als(single_mesh(), d1, params)
+    d8 = ALSData.build(users, items, ratings, nu, ni, n_shards=8)
+    U8, V8 = train_als(mesh8, d8, params)
+    np.testing.assert_allclose(U1, U8, rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(V1, V8, rtol=2e-2, atol=2e-3)
+    assert abs(rmse(U1, V1, users, items, ratings)
+               - rmse(U8, V8, users, items, ratings)) < 1e-3
+
+
+def test_subspace_sharded_implicit_matches_single(mesh8):
+    """The sharded-Gramian path (per-device partial V^T V + psum) must
+    agree with the single-device local Gramian."""
+    users, items, ratings, nu, ni = synthetic_ratings(seed=8)
+    counts = np.abs(ratings) + 0.5
+    params = ALSParams(rank=6, num_iterations=4, reg=0.1, alpha=2.0,
+                       implicit_prefs=True, seed=4, chunk_size=64,
+                       solver="subspace", block_size=3)
+    U1, V1 = train_als(single_mesh(),
+                       ALSData.build(users, items, counts, nu, ni,
+                                     n_shards=1), params)
+    U8, V8 = train_als(mesh8,
+                       ALSData.build(users, items, counts, nu, ni,
+                                     n_shards=8), params)
+    np.testing.assert_allclose(U1, U8, rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(V1, V8, rtol=2e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# Compile ledger: distinct (rank, block_size) families
+# ---------------------------------------------------------------------------
+
+def _compile_total(family):
+    from predictionio_tpu.obs.jax_stats import compile_counter
+
+    for labels, value in compile_counter().samples():
+        if labels.get("family") == family:
+            return value
+    return 0.0
+
+
+def test_train_compile_ledger_bounded_by_rank_block_families():
+    # unique dataset dims so cache keys cannot collide with other tests
+    users, items, ratings, nu, ni = synthetic_ratings(
+        n_users=53, n_items=29, seed=9)
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    mesh = single_mesh()
+
+    before = _compile_total("als_train")
+    combos = [(4, 2), (4, 3), (6, 2)]
+    for rank, block in combos:
+        for _ in range(3):      # repeated trains reuse the cached program
+            train_als(mesh, data, ALSParams(
+                rank=rank, num_iterations=2, reg=0.05, seed=1,
+                chunk_size=64, solver="subspace", block_size=block))
+    delta = _compile_total("als_train") - before
+    assert delta == len(combos), (
+        f"ledger grew by {delta} over 9 train calls spanning "
+        f"{len(combos)} distinct (rank, block_size) families")
+    # full-solver trains of the same ranks are their OWN families
+    for rank in (4, 6):
+        train_als(mesh, data, ALSParams(
+            rank=rank, num_iterations=2, reg=0.05, seed=1, chunk_size=64))
+    assert _compile_total("als_train") - before == len(combos) + 2
+
+
+def test_full_solver_block_size_is_key_inert():
+    """A full-solver train that merely CARRIES a different resolved
+    block_size (e.g. PIO_ALS_BLOCK_SIZE set on a full box) must reuse
+    the same compiled program — block_size only shapes subspace code."""
+    users, items, ratings, nu, ni = synthetic_ratings(
+        n_users=47, n_items=31, seed=12)
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    mesh = single_mesh()
+    before = _compile_total("als_train")
+    for block in (16, 32, 9):
+        train_als(mesh, data, ALSParams(
+            rank=5, num_iterations=2, reg=0.05, seed=1, chunk_size=64,
+            block_size=block))
+    assert _compile_total("als_train") - before == 1
+
+
+def test_subspace_checkpointed_chunks_match_straight_run(tmp_path):
+    """Block coordinate descent refines U across iterations, so the
+    checkpointed path must thread (U, V) through chunk boundaries and
+    snapshot BOTH — chunked matches unchunked to float noise (a cold
+    U restart per chunk diverges by ~1e-1), and a resume from the
+    snapshot reproduces the uninterrupted run."""
+    from predictionio_tpu.workflow.checkpoint import Checkpointer
+
+    users, items, ratings, nu, ni = synthetic_ratings(
+        n_users=41, n_items=23, seed=13)
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    mesh = single_mesh()
+    params = ALSParams(rank=6, num_iterations=6, reg=0.05, seed=3,
+                       chunk_size=64, solver="subspace", block_size=2)
+    U0, V0 = train_als(mesh, data, params)
+    ck = Checkpointer(str(tmp_path), interval=2)
+    U1, V1 = train_als(mesh, data, params, checkpointer=ck)
+    # chunked vs straight run the same math through differently-compiled
+    # programs: near-identical, not guaranteed bitwise
+    np.testing.assert_allclose(U0, U1, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(V0, V1, rtol=1e-3, atol=1e-4)
+    # crash-resume: a fresh run finds the last (U, V) snapshot and
+    # continues to the same result
+    snaps = ck._scan()
+    assert snaps, "interval=2 over 6 iterations must snapshot"
+    assert all("U" in __import__("pickle").load(
+        open(tmp_path / name, "rb"))["state"]
+        for _s, _t, name in snaps), "subspace snapshots must carry U"
+    U2, V2 = train_als(mesh, data, params, checkpointer=ck)
+    np.testing.assert_allclose(U0, U2, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(V0, V2, rtol=1e-3, atol=1e-4)
+
+
+def test_subspace_implicit_checkpoint_resume_on_padded_mesh(
+        tmp_path, mesh8):
+    """Implicit subspace training on a mesh whose item padding is real
+    (n_items % n_shards != 0): snapshots truncate V at n_items and
+    resume zero-pads, so V's padding rows must be zero THROUGHOUT —
+    they start zero at init, and a pad row's block update keeps them
+    zero (rhs = -(x G)_b with x = 0). A random-init pad row would decay
+    but never vanish under block descent, polluting the cached global
+    V^T V Gramian and making a resumed run diverge from the
+    uninterrupted one."""
+    from predictionio_tpu.workflow.checkpoint import Checkpointer
+
+    users, items, ratings, nu, ni = synthetic_ratings(
+        n_users=41, n_items=23, seed=17)   # 23 % 8 != 0: one pad row
+    counts = np.abs(ratings) + 0.5
+    params = ALSParams(rank=6, num_iterations=6, reg=0.1, alpha=2.0,
+                       implicit_prefs=True, seed=5, chunk_size=64,
+                       solver="subspace", block_size=3)
+    data = ALSData.build(users, items, counts, nu, ni, n_shards=8)
+    U0, V0 = train_als(mesh8, data, params)
+    ck = Checkpointer(str(tmp_path), interval=2)
+    U1, V1 = train_als(mesh8, data, params, checkpointer=ck)
+    np.testing.assert_allclose(U0, U1, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(V0, V1, rtol=1e-3, atol=1e-4)
+    # resume from the mid-run snapshot reproduces the uninterrupted run
+    assert ck._scan(), "interval=2 over 6 iterations must snapshot"
+    U2, V2 = train_als(mesh8, data, params, checkpointer=ck)
+    np.testing.assert_allclose(U0, U2, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(V0, V2, rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Solver metrics
+# ---------------------------------------------------------------------------
+
+def test_subspace_train_emits_block_sweep_metrics():
+    from predictionio_tpu.obs.train_stats import (
+        als_block_sweeps, als_gramian_cache_hits,
+    )
+
+    def value(counter):
+        return sum(v for _l, v in counter.samples())
+
+    users, items, ratings, nu, ni = synthetic_ratings(seed=10)
+    data = ALSData.build(users, items, ratings, nu, ni, n_shards=1)
+    sweeps0 = value(als_block_sweeps())
+    hits0 = value(als_gramian_cache_hits())
+    train_als(single_mesh(), data, ALSParams(
+        rank=8, num_iterations=3, reg=0.05, seed=1, chunk_size=64,
+        solver="subspace", block_size=4))
+    # 3 iterations x 2 sides x 2 blocks of width 4 over rank 8
+    assert value(als_block_sweeps()) - sweeps0 == 12
+    # per half-sweep: every block after the first hits the cached terms
+    assert value(als_gramian_cache_hits()) - hits0 == 6
+
+
+# ---------------------------------------------------------------------------
+# Solver knob resolution (utils/server_config.als_solver_config)
+# ---------------------------------------------------------------------------
+
+def test_als_solver_config_defaults_and_algo_params(monkeypatch):
+    monkeypatch.delenv("PIO_ALS_SOLVER", raising=False)
+    monkeypatch.delenv("PIO_ALS_BLOCK_SIZE", raising=False)
+    assert als_solver_config(None) == ("full", 16)
+    assert als_solver_config({"mode": "subspace"}) == ("subspace", 16)
+    assert als_solver_config(
+        {"mode": "subspace", "block_size": 8}) == ("subspace", 8)
+    assert als_solver_config(
+        {"mode": "subspace", "blockSize": 4}) == ("subspace", 4)
+    with pytest.raises(ValueError, match="solver.mode"):
+        als_solver_config({"mode": "typo"})
+    with pytest.raises(ValueError, match="unknown solver params"):
+        als_solver_config({"mode": "full", "blokSize": 8})
+
+
+def test_als_solver_env_overrides_beat_algo_params(monkeypatch):
+    monkeypatch.setenv("PIO_ALS_SOLVER", "subspace")
+    monkeypatch.setenv("PIO_ALS_BLOCK_SIZE", "32")
+    # the operator override wins over the engine variant's own section
+    assert als_solver_config({"mode": "full"}) == ("subspace", 32)
+    assert als_solver_config(None) == ("subspace", 32)
+    # malformed env values are ignored, not fatal
+    monkeypatch.setenv("PIO_ALS_SOLVER", "wild")
+    monkeypatch.setenv("PIO_ALS_BLOCK_SIZE", "many")
+    assert als_solver_config({"mode": "full"}) == ("full", 16)
+
+
+def test_server_config_train_section(tmp_path, monkeypatch):
+    import json as _json
+
+    from predictionio_tpu.utils.server_config import ServerConfig
+
+    monkeypatch.delenv("PIO_ALS_SOLVER", raising=False)
+    monkeypatch.delenv("PIO_ALS_BLOCK_SIZE", raising=False)
+    path = tmp_path / "server.json"
+    path.write_text(_json.dumps(
+        {"train": {"alsSolver": "subspace", "alsBlockSize": 8}}))
+    cfg = ServerConfig.load(str(path))
+    assert cfg.train.als_solver == "subspace"
+    assert cfg.train.als_block_size == 8
+    # the host-level file section applies when the algo has no opinion
+    assert als_solver_config(None, config=cfg.train) == ("subspace", 8)
+    # ...and is found WITHOUT an explicit config: production callers
+    # (engines, CLI echo) pass nothing and must still see the file
+    monkeypatch.setenv("PIO_SERVER_CONF", str(path))
+    assert als_solver_config(None) == ("subspace", 8)
+    monkeypatch.delenv("PIO_SERVER_CONF")
+    # ...but an explicit algo section overrides the file's mode; the
+    # per-knob chain means the host block-size tuning still applies to a
+    # section that names only a mode (block_size is inert under "full")
+    assert als_solver_config({"mode": "full"},
+                             config=cfg.train) == ("full", 8)
+    assert als_solver_config({"mode": "full", "block_size": 4},
+                             config=cfg.train) == ("full", 4)
+    # ...and a section tuning ONLY block_size inherits the host mode
+    # (per-knob: it must not silently force "full")
+    assert als_solver_config({"block_size": 32},
+                             config=cfg.train) == ("subspace", 32)
+    # env beats both
+    monkeypatch.setenv("PIO_ALS_SOLVER", "full")
+    assert als_solver_config(None, config=cfg.train)[0] == "full"

@@ -209,6 +209,44 @@ def test_bench_ingest_write_smoke(tmp_path):
     assert detail["speedup_headline"] >= 1.5
 
 
+def test_bench_als_kernel_smoke(tmp_path):
+    """Smoke the als_kernel config at a shrunken scale: the config itself
+    asserts held-out RMSE parity at matched quality and the als_train
+    compile-ledger bound; the emitted detail must carry the per-rank
+    timing/RMSE/speedup fields the judged run records. The judged-scale
+    speedup floor is 2x at rank >= 64 (the tentpole bar); the smoke floor
+    is relaxed — at smoke scale the solve is too small for the full
+    path's bandwidth wall to show above 2-core CI scheduler noise."""
+    p = _run("als_kernel", "300", timeout=280, tmp_path=tmp_path,
+             extra_env={"BENCH_ALS_USERS": "300",
+                        "BENCH_ALS_ITEMS": "120",
+                        "BENCH_ALS_NNZ": "9000",
+                        "BENCH_ALS_ITERS": "4",
+                        "BENCH_ALS_RANKS": "8,64",
+                        "BENCH_ALS_BLOCK": "8",
+                        "BENCH_ALS_MIN_SPEEDUP": "0",
+                        "BENCH_ALS_RMSE_SLACK": "0.2"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert "als_kernel" in out["unit"]
+    detail = next(d for d in
+                  json.load(open(tmp_path / "details.json"))["details"]
+                  if d["name"] == "als_kernel")
+    for rank in (8, 64):
+        for key in (f"train_s_full_r{rank}", f"train_s_subspace_r{rank}",
+                    f"heldout_rmse_full_r{rank}",
+                    f"heldout_rmse_subspace_r{rank}",
+                    f"speedup_r{rank}"):
+            assert key in detail, (key, detail)
+        assert detail[f"train_s_subspace_r{rank}"] > 0
+    # one compiled program per (rank, solver) family, never per train call
+    assert 0 < detail["compile_ledger_delta"] <= 4
+    assert detail["speedup_headline"] is not None
+    assert detail["iters_subspace"] >= detail["iters_full"]
+
+
 def test_every_bench_config_has_smoke():
     """Static gate: every bench.py config must either have a `_run(...)`
     smoke in this file or a justified HEAVY_EXEMPT entry — future

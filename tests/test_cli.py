@@ -85,6 +85,8 @@ def test_cli_full_lifecycle(clienv, tmp_path, monkeypatch):
     (tmp_path / "engine.json").write_text(json.dumps(variant))
     out = _ok(r.invoke(cli, ["train"]))
     assert "Training completed" in out
+    # the resolved training solver is echoed (README "Training kernel")
+    assert "ALS solver full (block size 16)" in out
 
     # the train registered release v1 (deploy/ registry surface)
     out = _ok(r.invoke(cli, ["releases"]))

@@ -198,6 +198,57 @@ def test_sweep_kind_not_inherited_past_custom_math():
     assert result.best_score == 1.0               # the override ran
 
 
+def test_subspace_sweep_batched_matches_sequential():
+    """`pio eval` grids inherit the subspace training kernel: candidates
+    carrying solver="subspace" ride the vmapped sweep, and the batched
+    execution matches the sequential execution of the SAME kernels —
+    including the best-candidate pick."""
+    nu, ni, nnz, k = 48, 28, 1400, 3
+    users, items, ratings = _synthetic(nu, ni, nnz, seed=6)
+    fold_of = fold_assignments(k, nnz)
+    data = build_sweep_data(users, items, ratings, fold_of, nu, ni)
+    cands = [ALSParams(rank=r, num_iterations=3, reg=g, chunk_size=2048,
+                       solver="subspace", block_size=2)
+             for r in (4, 6) for g in (0.02, 0.2)]
+    batched = run_sweep(data, cands)
+    sequential = run_sweep(data, cands, batched=False)
+    assert batched.n_groups == 2        # two (rank, block_size) families
+    for cb, cs in zip(batched.candidates, sequential.candidates):
+        assert cb.heldout_rmse == pytest.approx(cs.heldout_rmse, abs=1e-5)
+        assert cb.group.endswith("/sub2")
+    best_b = min(range(len(cands)),
+                 key=lambda i: batched.candidates[i].heldout_rmse)
+    best_s = min(range(len(cands)),
+                 key=lambda i: sequential.candidates[i].heldout_rmse)
+    assert best_b == best_s
+
+
+def test_sweep_groups_split_by_solver_family():
+    """Compile groups are (rank, solver, block_size) families: full
+    candidates group together regardless of the block_size they happen
+    to carry; each distinct subspace block_size is its own program."""
+    nu, ni, nnz, kf = 21, 11, 400, 2
+    users, items, ratings = _synthetic(nu, ni, nnz, seed=7)
+    data = build_sweep_data(users, items, ratings,
+                            fold_assignments(kf, nnz), nu, ni)
+    cands = [
+        ALSParams(rank=4, num_iterations=2, reg=0.1),
+        ALSParams(rank=4, num_iterations=2, reg=0.2, block_size=9),
+        ALSParams(rank=4, num_iterations=2, reg=0.1,
+                  solver="subspace", block_size=2),
+        ALSParams(rank=4, num_iterations=2, reg=0.1,
+                  solver="subspace", block_size=3),
+    ]
+    res = run_sweep(data, cands)
+    assert res.n_groups == 3
+    groups = [c.group for c in res.candidates]
+    assert groups[0] == groups[1]               # full: block_size inert
+    assert groups[2].endswith("/sub2")
+    assert groups[3].endswith("/sub3")
+    with pytest.raises(ValueError, match="unknown ALS solver"):
+        run_sweep(data, [ALSParams(rank=4, solver="nope")])
+
+
 def test_mixed_iterations_share_a_compile_group():
     """num_iterations is shape-preserving: candidates differing only in
     iteration count ride ONE compile group (traced per-unit trip count),

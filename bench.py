@@ -55,6 +55,10 @@ Configs (order = bank cheap+judged numbers first, riskiest last):
   ingest_write      event WRITE hot path: per-request inserts vs the
                     group-commit WriteBuffer on sqlite + parquet,
                     events/s + ack p99 (asserts >=5x and exactly-once)
+  batch_predict     offline batch scoring: sequential-chunk loop vs the
+                    pipelined reader->scorer->writer vs a 2-process
+                    sharded fleet, queries/s (asserts >=4x best path,
+                    byte-identical output, bounded compile ledger)
   als_ml20m         MovieLens-20M ALS on one chip: 20M ratings,
                     138k x 27k, string-id assignment + data build +
                     train + RMSE all timed (north star, BASELINE.md)
@@ -1661,6 +1665,361 @@ def cfg_ingest_write(jax, mesh, platform):
     return detail
 
 
+def _batchpredict_result(nu, ni, rank, seed=11):
+    """Synthetic trained recommendation engine (no storage, no train):
+    the deterministic fixture shared by the parent bench AND the sharded
+    worker children, so every process scores the identical model."""
+    from predictionio_tpu.core.engine import TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, RecommendationServing)
+    from predictionio_tpu.models.als import ALSModel
+
+    rng = np.random.default_rng(seed)
+    model = ALSModel(
+        user_vocab=np.asarray([f"u{i:06d}" for i in range(nu)],
+                              dtype=object),
+        item_vocab=np.asarray([f"i{i:06d}" for i in range(ni)],
+                              dtype=object),
+        U=rng.normal(size=(nu, rank)).astype(np.float32),
+        V=rng.normal(size=(ni, rank)).astype(np.float32))
+    return TrainResult(models=[model],
+                       algorithms=[ALSAlgorithm(AlgorithmParams())],
+                       serving=RecommendationServing(),
+                       engine_params=EngineParams())
+
+
+def _batchpredict_sequential(result, input_path, output_path, chunk_size):
+    """Frozen replica of the pre-pipeline `run_batch_predict` (the
+    66-line sequential-chunk loop this PR replaced): line-by-line JSON
+    parse, per-chunk batch_predict, asdict/to_dict serialization and
+    synchronous per-line writes, all interleaved on one thread. Kept
+    here verbatim as the measured baseline — the shared engine kernels
+    underneath are today's, so the ratio isolates the architecture
+    (pipelining + columnar serialization + sharding), not kernel drift."""
+    import dataclasses as _dc
+
+    from predictionio_tpu.core.params import params_from_json
+    from predictionio_tpu.server.query_server import _query_class
+
+    qc = _query_class(result)
+
+    def _to_jsonable(obj):
+        if hasattr(obj, "to_dict"):
+            return obj.to_dict()
+        if _dc.is_dataclass(obj) and not isinstance(obj, type):
+            return _dc.asdict(obj)
+        return obj
+
+    def _process_chunk(chunk, fout):
+        queries = [params_from_json(q, qc) if qc else q for q in chunk]
+        supplemented = [(i, result.serving.supplement(q))
+                        for i, q in enumerate(queries)]
+        per_algo = []
+        for algo, model in zip(result.algorithms, result.models):
+            per_algo.append(dict(algo.batch_predict(model, supplemented)))
+        for i, (raw, q) in enumerate(zip(chunk, queries)):
+            predictions = [preds[i] for preds in per_algo]
+            served = result.serving.serve(q, predictions)
+            fout.write(json.dumps(
+                {"query": raw, "prediction": _to_jsonable(served)},
+                sort_keys=True) + "\n")
+        return len(chunk)
+
+    n = 0
+    with open(input_path) as fin, open(output_path, "w") as fout:
+        chunk = []
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            chunk.append(json.loads(line))
+            if len(chunk) >= chunk_size:
+                n += _process_chunk(chunk, fout)
+                chunk = []
+        if chunk:
+            n += _process_chunk(chunk, fout)
+    return n
+
+
+def _batchpredict_worker():
+    """Sharded child entry: `python -c "import bench;
+    bench._batchpredict_worker()"` with the fixture shape in BENCH_BP_*
+    env and the shard identity in PIO_PROCESS_ID / PIO_NUM_PROCESSES —
+    exactly how an operator runs a batchpredict fleet, minus `pio`.
+
+    Rendezvous files keep one-time process setup (interpreter + jax
+    import, model restore, BLAS probe warmup) OUT of the parent's
+    measured window: the child warms up, drops `<out>.ready-<rank>`,
+    and scores only once `<out>.go` appears — the fleet analog of
+    serving_batching compiling its shape ladder outside the timed
+    sweep. Steady-state throughput is the judged number; spawn cost is
+    one-time and reported by the parent as `shard_spawn_s`."""
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    result = _batchpredict_result(
+        int(os.environ["BENCH_BP_USERS"]),
+        int(os.environ["BENCH_BP_ITEMS"]),
+        int(os.environ["BENCH_BP_RANK"]))
+    out = os.environ["BENCH_BP_OUTPUT"]
+    chunk = int(os.environ["BENCH_BP_CHUNK"])
+    rank = os.environ["PIO_PROCESS_ID"]
+    warm_in = os.environ.get("BENCH_BP_WARM_INPUT")
+    if warm_in:
+        # rank-unique warm path: sharded children share BENCH_BP_OUTPUT,
+        # and two warm passes racing the same file can unlink each other
+        warm_out = f"{out}.warm-{rank}"
+        run_batch_predict(None, None, warm_in, warm_out,
+                          chunk_size=chunk, loaded=(result, None),
+                          worker=(0, 1))
+        os.unlink(warm_out)
+    with open(f"{out}.ready-{rank}", "w") as f:
+        f.write("ready")
+    deadline = time.time() + 120
+    while not os.path.exists(f"{out}.go"):
+        if time.time() > deadline:
+            raise TimeoutError("no go signal from the bench parent")
+        time.sleep(0.005)
+    run_batch_predict(
+        None, None, os.environ["BENCH_BP_INPUT"], out,
+        chunk_size=chunk, loaded=(result, None))
+
+
+def _assert_parquet_value_parity(parquet_path, jsonl_path):
+    """The parquet output (structured wire columns OR the JSON-string
+    layout) must carry exactly the sequential baseline's values, row for
+    row: parse both sides back to plain objects and compare — the
+    order-normalized byte-identity bar of the acceptance criteria, made
+    format-agnostic."""
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(parquet_path)
+    queries = table.column("query").to_pylist()
+    preds = table.column("prediction").to_pylist()
+    with open(jsonl_path) as f:
+        expect = [json.loads(line) for line in f if line.strip()]
+    assert len(queries) == len(expect), (
+        f"parquet row count {len(queries)} != baseline {len(expect)}")
+    for i, (q, p, e) in enumerate(zip(queries, preds, expect)):
+        if isinstance(p, str):
+            p = json.loads(p)
+        assert json.loads(q) == e["query"], f"query row {i} differs"
+        assert p == e["prediction"], f"prediction row {i} differs"
+
+
+def cfg_batch_predict(jax, mesh, platform):
+    """Offline batch scoring: the pre-PR sequential-chunk loop vs the
+    pipelined reader->scorer->writer, and vs a 2-process sharded fleet
+    (contiguous row ranges + manifest merge) — queries/sec, best-of-2.
+
+    Asserts the tentpole bar: byte-identical output across all three
+    paths, the compile-shape ledger bounded by the bucket ladder when
+    the device scorer is forced, and the throughput floor
+    (BENCH_BP_MIN_SPEEDUP, default 4x) for the best parallel path over
+    the sequential baseline. The workload is serialization-heavy
+    (num=50 recommendations/query) — the regime offline exports live
+    in, and the one the columnar lane + pipelining attack; the sharded
+    side then scales the remaining per-process Python with the fleet,
+    the way ALX lays offline factorization across chips."""
+    import glob
+    import tempfile
+
+    import predictionio_tpu.models.als as als_mod
+    from predictionio_tpu.ops import bucketing, fn_cache
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    nu = int(os.environ.get("BENCH_BP_USERS", 5000))
+    ni = int(os.environ.get("BENCH_BP_ITEMS", 2000))
+    rank = int(os.environ.get("BENCH_BP_RANK", 32))
+    num = int(os.environ.get("BENCH_BP_NUM", 50))
+    n_queries = int(os.environ.get("BENCH_BP_QUERIES", 40000))
+    chunk = int(os.environ.get("BENCH_BP_CHUNK", 1024))
+    shards = int(os.environ.get("BENCH_BP_SHARDS", 2))
+    min_speedup = float(os.environ.get("BENCH_BP_MIN_SPEEDUP", 4.0))
+    min_pipe = float(os.environ.get("BENCH_BP_MIN_PIPE", 1.1))
+
+    result = _batchpredict_result(nu, ni, rank)
+    work = tempfile.mkdtemp(prefix="bench_bp_")
+    inp = os.path.join(work, "queries.jsonl")
+    with open(inp, "w") as f:
+        for i in range(n_queries):
+            f.write(json.dumps({"user": f"u{i % nu:06d}", "num": num})
+                    + "\n")
+
+    def read(path):
+        with open(path) as f:
+            return f.read()
+
+    # warm the BLAS/crossover probes and caches outside every measured
+    # window, symmetrically for both sides (a chunk-sized slice is
+    # enough — the measured runs below then start hot)
+    hb("batch_predict warmup")
+    warm_in = os.path.join(work, "warm_in.jsonl")
+    with open(inp) as f, open(warm_in, "w") as g:
+        for _ in range(min(n_queries, chunk + 1)):
+            g.write(f.readline())
+    _batchpredict_sequential(result, warm_in,
+                             os.path.join(work, "warm1.jsonl"), chunk)
+    run_batch_predict(None, None, warm_in,
+                      os.path.join(work, "warm2.jsonl"),
+                      chunk_size=chunk, loaded=(result, None))
+
+    hb("batch_predict sequential baseline")
+    seq_out = os.path.join(work, "seq.jsonl")
+    seq_s, _ = timed_best(
+        lambda: _batchpredict_sequential(result, inp, seq_out, chunk),
+        repeats=2)
+
+    hb("batch_predict pipelined")
+    pipe_out = os.path.join(work, "pipe.jsonl")
+    pipe_s, pipe_report = timed_best(
+        lambda: run_batch_predict(None, None, inp, pipe_out,
+                                  chunk_size=chunk, loaded=(result, None)),
+        repeats=2)
+    assert read(pipe_out) == read(seq_out), \
+        "pipelined output differs from the sequential baseline"
+
+    # columnar output: same pipeline, parquet sink fed by the engine's
+    # arrow lane — scores leave as ONE structured column per chunk, no
+    # per-row Python objects anywhere between top-k and the file. This
+    # is the tentpole throughput path; its speedup rides the headline.
+    hb("batch_predict pipelined parquet")
+    cols_out = os.path.join(work, "pipe.parquet")
+    cols_s, _ = timed_best(
+        lambda: run_batch_predict(None, None, inp, cols_out,
+                                  chunk_size=chunk, loaded=(result, None)),
+        repeats=2)
+    _assert_parquet_value_parity(cols_out, seq_out)
+
+    # sharded fleet: N real processes over contiguous row ranges, merged
+    # by manifest. One-time setup (spawn, jax import, model restore)
+    # stays outside the window via the worker's ready/go rendezvous;
+    # it is reported separately as shard_spawn_s.
+    hb(f"batch_predict sharded x{shards}")
+    shard_out = os.path.join(work, "shard.parquet")
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    child_env = {**os.environ,
+                 "JAX_PLATFORMS": "cpu",
+                 "BENCH_BP_USERS": str(nu), "BENCH_BP_ITEMS": str(ni),
+                 "BENCH_BP_RANK": str(rank), "BENCH_BP_CHUNK": str(chunk),
+                 "BENCH_BP_INPUT": inp, "BENCH_BP_OUTPUT": shard_out,
+                 "BENCH_BP_WARM_INPUT": warm_in,
+                 "PIO_NUM_PROCESSES": str(shards)}
+    spawn_s = [0.0]
+
+    def run_sharded():
+        for stale in glob.glob(shard_out + "*"):
+            os.unlink(stale)
+        t_spawn = time.perf_counter()
+        procs = [subprocess.Popen(
+            [sys.executable, "-c",
+             "import bench; bench._batchpredict_worker()"],
+            cwd=repo_root, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env={**child_env, "PIO_PROCESS_ID": str(p)})
+            for p in range(shards)]
+        try:
+            deadline = time.time() + 300
+            while not all(os.path.exists(f"{shard_out}.ready-{p}")
+                          for p in range(shards)):
+                for p in procs:
+                    assert p.poll() is None, \
+                        f"shard died in setup:\n{p.communicate()[1][-2000:]}"
+                assert time.time() < deadline, "shard setup timed out"
+                time.sleep(0.01)
+            spawn_s[0] = time.perf_counter() - t_spawn
+            t0 = time.perf_counter()
+            with open(f"{shard_out}.go", "w") as f:
+                f.write("go")
+            for p in procs:
+                _out, err = p.communicate(timeout=600)
+                assert p.returncode == 0, f"shard failed:\n{err[-2000:]}"
+            elapsed = time.perf_counter() - t0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        assert os.path.exists(shard_out), "no merged shard output"
+        shard_inner_s.append(elapsed)
+        return elapsed
+
+    shard_inner_s = []
+    timed_best(run_sharded, repeats=2)
+    # judge best-of-N of the INNER elapsed (go-signal to last exit):
+    # the outer wall timed_best sees includes spawn/rendezvous waiting
+    shard_s = min(shard_inner_s)
+    _assert_parquet_value_parity(shard_out, seq_out)
+
+    # compile-shape ledger: force the device scorer (the TPU-serving
+    # path; host-BLAS crossover would hide it on CPU) over a slice that
+    # exercises full AND partial chunks — distinct compiled batch shapes
+    # must stay inside the bucket ladder of the maximal bucket.
+    hb("batch_predict ledger check")
+    slice_in = os.path.join(work, "slice.jsonl")
+    with open(inp) as f, open(slice_in, "w") as g:
+        for _ in range(2 * chunk + 17):
+            g.write(f.readline())
+    old_rt = als_mod._DEVICE_ROUNDTRIP_S
+    als_mod._DEVICE_ROUNDTRIP_S = 0.0
+    try:
+        run_batch_predict(None, None, slice_in,
+                          os.path.join(work, "ledger.jsonl"),
+                          chunk_size=chunk, loaded=(result, None))
+    finally:
+        als_mod._DEVICE_ROUNDTRIP_S = old_rt
+    shapes = sorted({k[0] for fam in ("als_topk", "als_topk_masked")
+                     for k in fn_cache.family_keys(fam)
+                     if k[2:] == (ni, rank)})
+    bound = bucketing.bucket_count(chunk)
+    assert 0 < len(shapes) <= bound, (
+        f"bucketing leak: {len(shapes)} compiled batch shapes {shapes} "
+        f"> bound {bound}")
+
+    qps_seq = n_queries / seq_s
+    qps_pipe = n_queries / pipe_s
+    qps_cols = n_queries / cols_s
+    qps_shard = n_queries / shard_s
+    speedup_pipe = qps_pipe / qps_seq
+    speedup_cols = qps_cols / qps_seq
+    speedup_shard = qps_shard / qps_seq
+    headline = max(speedup_pipe, speedup_cols, speedup_shard)
+    if min_pipe > 0:
+        assert speedup_pipe >= min_pipe, (
+            f"pipelined jsonl path only {speedup_pipe:.2f}x over the "
+            f"sequential-chunk baseline (floor {min_pipe}x)")
+    if min_speedup > 0:
+        assert headline >= min_speedup, (
+            f"best batchpredict path only {headline:.2f}x over the "
+            f"sequential-chunk baseline (floor {min_speedup}x)")
+    return {
+        # judged pair: the tentpole columnar path vs the pre-PR
+        # sequential loop on the SAME 40k queries -> the orchestrator's
+        # derived speedup IS the headline ratio
+        "elapsed_s": round(cols_s, 3),
+        "baseline_s": round(seq_s, 3),
+        "queries": n_queries,
+        "qps_sequential": round(qps_seq, 1),
+        "qps_pipelined": round(qps_pipe, 1),
+        "qps_columnar": round(qps_cols, 1),
+        f"qps_sharded_{shards}proc": round(qps_shard, 1),
+        "speedup_pipelined": round(speedup_pipe, 2),
+        "speedup_columnar": round(speedup_cols, 2),
+        f"speedup_sharded_{shards}proc": round(speedup_shard, 2),
+        "speedup_headline": round(headline, 2),
+        "shard_spawn_s": round(spawn_s[0], 2),
+        "pad_waste_rows": pipe_report.pad_waste,
+        "distinct_compiled_batch_shapes": len(shapes),
+        "compile_shape_bound": bound,
+        "note": (f"{n_queries} queries (num={num}) on synthetic "
+                 f"{nu}x{ni} r{rank} factors, chunk {chunk}: sequential "
+                 f"{qps_seq:.0f} q/s, pipelined jsonl {qps_pipe:.0f} q/s "
+                 f"({speedup_pipe:.2f}x), columnar parquet "
+                 f"{qps_cols:.0f} q/s ({speedup_cols:.2f}x), "
+                 f"{shards}-proc sharded {qps_shard:.0f} q/s "
+                 f"({speedup_shard:.2f}x); value-identical outputs; "
+                 f"{len(shapes)} compiled batch shapes (bound {bound})"),
+    }
+
+
 def cfg_sleep_forever(jax, mesh, platform):
     """Test-only config (never in the default set): wedges the worker so
     the orchestrator's watchdog + ladder can be exercised on CPU."""
@@ -1682,6 +2041,7 @@ CONFIGS = {
     "deploy_swap": (cfg_deploy_swap, 240),
     "train_ingest": (cfg_train_ingest, 240),
     "ingest_write": (cfg_ingest_write, 240),
+    "batch_predict": (cfg_batch_predict, 300),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
 

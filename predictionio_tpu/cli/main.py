@@ -293,7 +293,8 @@ def _load_engine_variant(variant_path):
     factory = load_class(factory_path)
     engine = factory() if callable(factory) else factory.apply()
     engine_params = engine.engine_params_from_json(variant)
-    return engine, engine_params, factory_path, variant.get("id", "default")
+    return (engine, engine_params, factory_path,
+            variant.get("id", "default"), variant)
 
 
 @cli.command()
@@ -316,7 +317,7 @@ def train(variant, batch, skip_sanity_check, stop_after_read,
     """Train an engine instance (Console.scala:179, CoreWorkflow.runTrain)."""
     from predictionio_tpu.workflow import WorkflowParams, run_train
 
-    engine, engine_params, factory_path, variant_id = \
+    engine, engine_params, factory_path, variant_id, _ = \
         _load_engine_variant(variant)
     # echo the resolved ALS training solver for every ALS-backed
     # algorithm (engine.json "solver" section + PIO_ALS_SOLVER /
@@ -390,7 +391,7 @@ def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
     from predictionio_tpu.storage import Storage
     from predictionio_tpu.workflow.train import load_for_deploy
 
-    engine, _, factory_path, variant_id = _load_engine_variant(variant)
+    engine, _, factory_path, variant_id, _vj = _load_engine_variant(variant)
     instances = Storage.get_meta_data_engine_instances()
     release = None
     if release_selector:
@@ -456,7 +457,7 @@ def releases(variant, status_filter):
     """List release manifests for an engine variant (deploy/ registry)."""
     from predictionio_tpu.storage import Storage
 
-    engine, _, factory_path, variant_id = _load_engine_variant(variant)
+    engine, _, factory_path, variant_id, _vj = _load_engine_variant(variant)
     listing = Storage.get_meta_data_releases().get_for_variant(
         factory_path, "1", variant_id)
     if status_filter:
@@ -637,23 +638,77 @@ def eval_cmd(evaluation_path, params_generator_path, batch, grid_specs,
 @cli.command()
 @click.option("--variant", "-v", default="engine.json")
 @click.option("--input", "input_path", required=True,
-              help="File of one JSON query per line.")
-@click.option("--output", "output_path", required=True)
+              help="Queries: one JSON object per line, or a .parquet "
+                   "table (a 'query' JSON column or one column per "
+                   "query field).")
+@click.option("--output", "output_path", required=True,
+              help="Predictions: JSON-lines, or .parquet when the path "
+                   "(or --output-format) says so.")
 @click.option("--engine-instance-id", default=None)
-def batchpredict(variant, input_path, output_path, engine_instance_id):
-    """Batch scoring (Console.scala:331, BatchPredict.scala:71)."""
+@click.option("--release", "release_selector", default=None,
+              help="Score with a specific release (id, version number "
+                   "or vN) from `pio releases`, like `pio deploy`.")
+@click.option("--chunk-size", type=int, default=None,
+              help="Maximal scoring bucket (default from server.json "
+                   "batchpredict section / PIO_BATCHPREDICT_CHUNK_SIZE; "
+                   "1024 out of the box).")
+@click.option("--output-format", "output_format",
+              type=click.Choice(["jsonl", "parquet"]), default=None,
+              help="Force the output format instead of inferring from "
+                   "the --output extension.")
+@click.option("--input-format", "input_format",
+              type=click.Choice(["jsonl", "parquet"]), default=None)
+def batchpredict(variant, input_path, output_path, engine_instance_id,
+                 release_selector, chunk_size, output_format, input_format):
+    """Offline batch scoring (Console.scala:331, BatchPredict.scala:71):
+    pipelined reader->scorer->writer over the engine's bucketed batch
+    path. Multi-process sharding rides the PIO_PROCESS_ID /
+    PIO_NUM_PROCESSES env contract: run one `pio batchpredict` per
+    shard and the last to finish merges the fragments."""
+    from predictionio_tpu.deploy.releases import resolve_release
     from predictionio_tpu.storage import Storage
     from predictionio_tpu.workflow.batch_predict import run_batch_predict
 
-    engine, _, factory_path, variant_id = _load_engine_variant(variant)
+    engine, _, factory_path, variant_id, variant_json = \
+        _load_engine_variant(variant)
+    variant_conf = variant_json.get("batchpredict")
     instances = Storage.get_meta_data_engine_instances()
-    instance = (instances.get(engine_instance_id) if engine_instance_id
-                else instances.get_latest_completed(factory_path, "1", variant_id))
+    if release_selector:
+        release = resolve_release(Storage.get_meta_data_releases(),
+                                  factory_path, "1", variant_id,
+                                  release_selector)
+        if release is None:
+            click.echo(f"[ERROR] Release {release_selector} not found "
+                       "(see `pio releases`). Aborting.")
+            sys.exit(1)
+        instance = instances.get(release.instance_id)
+        if instance is not None and instance.status == "COMPLETED":
+            click.echo(f"[INFO] Scoring with release v{release.version} "
+                       f"(instance {release.instance_id})")
+    elif engine_instance_id:
+        instance = instances.get(engine_instance_id)
+    else:
+        instance = instances.get_latest_completed(
+            factory_path, "1", variant_id)
     if instance is None or instance.status != "COMPLETED":
         click.echo("[ERROR] No COMPLETED engine instance found. Aborting.")
         sys.exit(1)
-    n = run_batch_predict(engine, instance, input_path, output_path)
-    click.echo(f"[INFO] Wrote {n} predictions to {output_path}")
+    report = run_batch_predict(
+        engine, instance, input_path, output_path, chunk_size=chunk_size,
+        output_format=output_format, input_format=input_format,
+        variant_conf=variant_conf)
+    if report.merged:
+        click.echo(f"[INFO] Wrote {report.total_written} predictions to "
+                   f"{report.output_path}")
+    else:
+        rank, size = report.worker
+        click.echo(f"[INFO] Shard {rank}/{size} wrote {report.written} "
+                   f"predictions to fragment {report.output_path} "
+                   "(awaiting merge by the last shard)")
+    if report.invalid or (report.total_invalid or 0):
+        n_bad = report.total_invalid if report.merged else report.invalid
+        click.echo(f"[WARN] Skipped {n_bad} invalid queries "
+                   f"-> {report.errors_path}")
 
 
 # ---------------------------------------------------------------------------

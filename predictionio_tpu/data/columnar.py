@@ -267,6 +267,53 @@ def property_column(table: pa.Table, key: str, dtype=np.float32) -> np.ndarray:
     return out
 
 
+#: columnar batch-scoring I/O (workflow/batch_predict.py): queries in, one
+#: row per query. Two accepted input layouts — a single ``query`` column of
+#: JSON-encoded objects (the JSON-lines file, columnized), or one column
+#: per query FIELD (the natural parquet idiom; null cells are absent keys).
+QUERIES_SCHEMA = pa.schema([("query", pa.string())])
+
+#: batch-predict columnar output: the same self-descriptive
+#: {query, prediction} pair as the JSON-lines format, one row per query,
+#: both sides canonical JSON (sort_keys) so outputs diff cleanly
+PREDICTIONS_SCHEMA = pa.schema([
+    ("query", pa.string()),
+    ("prediction", pa.string()),
+])
+
+
+def predictions_schema(prediction_type: "pa.DataType" = None) -> pa.Schema:
+    """The batch-predict parquet output schema. With a `prediction_type`
+    (an engine's ``Algorithm.columnar_wire_type()``) the prediction
+    column is STRUCTURED — real arrow columns downstream can project,
+    not JSON strings they must re-parse; without one it falls back to
+    the generic JSON-string layout (PREDICTIONS_SCHEMA)."""
+    if prediction_type is None:
+        return PREDICTIONS_SCHEMA
+    return pa.schema([("query", pa.string()),
+                      ("prediction", prediction_type)])
+
+
+def query_table_rows(table: pa.Table):
+    """Decode a columnar query table into per-row raw values for the
+    batch-predict reader: a list whose entries are JSON strings (the
+    ``query``-column layout — parsed downstream so a malformed cell
+    becomes a per-row error record, not an abort) or plain dicts (the
+    field-per-column layout, nulls dropped)."""
+    if "query" in table.column_names:
+        return table.column("query").to_pylist()
+    rows = table.to_pylist()
+    return [{k: v for k, v in row.items() if v is not None} for row in rows]
+
+
+def queries_to_table(queries) -> pa.Table:
+    """JSON-encodable query objects -> the ``query``-column layout
+    (canonical sort_keys encoding)."""
+    return pa.table(
+        {"query": [json.dumps(q, sort_keys=True) for q in queries]},
+        schema=QUERIES_SCHEMA)
+
+
 def ratings_arrays(table: pa.Table, rating_key: str = "rating",
                    default_rating: float = 1.0):
     """(user_ids, item_ids, ratings) numpy views of an interaction table.

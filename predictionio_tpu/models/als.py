@@ -1195,6 +1195,56 @@ class ALSModel:
         top_k) or host BLAS, whichever the measured dispatch-latency
         crossover says is faster (`_use_host`).
         """
+        out = [[] for _ in requests]
+        scored = self._score_topk(requests)
+        if scored is None:
+            return out
+        rows, scores, idx, _k = scored
+        n_items = len(self.item_vocab)
+        # vectorized result assembly: ONE finite-mask + ONE tolist (C-level
+        # float conversion) + per-row vocab gathers instead of a Python
+        # isfinite/str/float call per recommended item — on a big offline
+        # batch the per-item churn here was costing more than the matmul
+        finite = np.isfinite(scores)
+        score_rows = scores.tolist()
+        for b, j in enumerate(rows):
+            want = min(requests[j][1], n_items)
+            names = self.item_vocab[idx[b][:want]]
+            fin_b, s_b = finite[b], score_rows[b]
+            out[j] = [(str(names[t]), s_b[t])
+                      for t in range(want) if fin_b[t]]
+        return out
+
+    def recommend_batch_arrays(self, requests):
+        """`recommend_batch` as flat columns — the offline-throughput
+        assembly (workflow/batch_predict.py arrow lane). Returns
+        ``(items, scores, counts)``: request ``j`` owns the slice
+        ``sum(counts[:j]) : sum(counts[:j+1])`` of the flat ``items``
+        (object ndarray of item ids) and ``scores`` (float64 ndarray;
+        float32 scores widened exactly as Python ``float()`` does, so
+        values match the list path bit for bit). Never materializes a
+        per-item Python tuple — at batch-scoring rates that churn costs
+        more than the matmul; counts are 0 for unknown users."""
+        counts = np.zeros(len(requests), dtype=np.int64)
+        scored = self._score_topk(requests)
+        empty = np.asarray([], dtype=object)
+        if scored is None:
+            return empty, np.asarray([], dtype=np.float64), counts
+        rows, scores, idx, k = scored
+        n_items = len(self.item_vocab)
+        want = np.fromiter(
+            (min(requests[j][1], n_items) for j in rows),
+            dtype=np.int64, count=len(rows))
+        take = np.isfinite(scores) & (np.arange(k)[None, :] < want[:, None])
+        counts[np.asarray(rows)] = take.sum(axis=1)
+        return (self.item_vocab[idx[take]],
+                scores[take].astype(np.float64), counts)
+
+    def _score_topk(self, requests):
+        """Shared scoring core of the recommend_batch family: validate,
+        gather known users, run the host-BLAS or bucketed-device matmul +
+        top-k. Returns (rows, scores[B,k], idx[B,k], k) over the known-user
+        rows, or None when no request has a known user."""
         n_items = len(self.item_vocab)
         for _u, num, _ex, _allow in requests:
             if num < 0:
@@ -1208,9 +1258,8 @@ class ALSModel:
                 uidx.append(ui)
                 if ex or allow is not None:
                     any_mask = True
-        out = [[] for _ in requests]
         if not rows:
-            return out
+            return None
         k = min(max(min(requests[j][1], n_items) for j in rows), n_items)
         u_batch = self.U[np.asarray(uidx)]
 
@@ -1252,14 +1301,7 @@ class ALSModel:
             scores, idx = jax.device_get((scores, idx))  # one fetch
             scores = scores[:len(rows), :k]
             idx = idx[:len(rows), :k]
-        for b, j in enumerate(rows):
-            want = min(requests[j][1], n_items)
-            recs = []
-            for s, i in zip(scores[b][:want], idx[b][:want]):
-                if np.isfinite(s):
-                    recs.append((str(self.item_vocab[i]), float(s)))
-            out[j] = recs
-        return out
+        return rows, scores, idx, k
 
 
 def rmse(model_U: np.ndarray, model_V: np.ndarray, user_idx: np.ndarray,

@@ -82,6 +82,46 @@ def _enable_cpu_collectives(jax) -> None:
             continue
 
 
+def resolve_worker(rank: Optional[int] = None,
+                   size: Optional[int] = None) -> "tuple[int, int]":
+    """This process's (rank, size) under the PIO_* process contract.
+
+    Explicit arguments win; then the ``PIO_PROCESS_ID`` /
+    ``PIO_NUM_PROCESSES`` env pair (the same contract
+    `initialize_distributed` reads — offline batch workers honor it
+    WITHOUT requiring the collective runtime, so a `pio batchpredict`
+    shard fleet is just N processes with two env vars each); then an
+    already-initialized multi-process jax runtime; else (0, 1).
+    """
+    if rank is not None and size is not None:
+        if not 0 <= rank < size:
+            raise ValueError(f"worker rank {rank} outside [0, {size})")
+        return rank, size
+    if "PIO_NUM_PROCESSES" in os.environ:
+        size = int(os.environ["PIO_NUM_PROCESSES"])
+        rank = int(os.environ.get("PIO_PROCESS_ID", "0"))
+        if not 0 <= rank < size:
+            raise ValueError(
+                f"PIO_PROCESS_ID={rank} outside [0, PIO_NUM_PROCESSES={size})")
+        return rank, size
+    if _initialized:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    return 0, 1
+
+
+def contiguous_range(n: int, rank: int, size: int) -> "tuple[int, int]":
+    """Row range [lo, hi) owned by `rank` of `size` over `n` rows:
+    contiguous, disjoint, covering, balanced to within one row (the
+    JdbcRDD-style partition bounds the sharded readers use)."""
+    if size <= 0 or not 0 <= rank < size:
+        raise ValueError(f"bad shard ({rank}, {size})")
+    base, extra = divmod(max(0, n), size)
+    lo = rank * base + min(rank, extra)
+    return lo, lo + base + (1 if rank < extra else 0)
+
+
 def process_count() -> int:
     import jax
 

@@ -19,7 +19,9 @@ Config file: ``$PIO_CONF_DIR/server.json`` (or the path in
                 "canaryPromoteAfter": 100, "canaryP99Ratio": 2.0},
      "ingest": {"maxEventsPerBatch": 50, "buffer": true, "queueMax": 8192,
                 "flushMax": 256, "lingerS": 0.002, "retries": 4},
-     "train": {"alsSolver": "subspace", "alsBlockSize": 16}}
+     "train": {"alsSolver": "subspace", "alsBlockSize": 16},
+     "batchpredict": {"chunkSize": 1024, "queueChunks": 4,
+                      "pipelined": true, "outputFormat": "jsonl"}}
 
 All fields optional; env vars ``PIO_SERVER_KEY`` / ``PIO_SSL_CERTFILE`` /
 ``PIO_SSL_KEYFILE`` override file values, as do the serving-tuning knobs
@@ -234,6 +236,101 @@ class TrainConfig:
         return cfg
 
 
+@dataclasses.dataclass
+class BatchPredictConfig:
+    """Offline batch-scoring tuning (the ``PIO_BATCHPREDICT_*`` knobs;
+    server.json ``batchpredict`` section, camelCase keys).
+
+    ``chunk_size`` is the maximal scoring bucket: chunks pad up the
+    power-of-two ladder to it (ops/bucketing), so the compile-shape
+    ledger of a run is bounded by ``bucket_count(chunk_size)`` exactly
+    as in serving. ``queue_chunks`` bounds both pipeline queues (reader→
+    scorer and scorer→writer), capping host memory at roughly
+    ``2 * queue_chunks * chunk_size`` buffered rows. ``pipelined=False``
+    runs the same stages inline on one thread (the measurement baseline;
+    also the safest setting when debugging an engine's batch_predict).
+    ``output_format`` names the format for output paths without a
+    recognized extension; an explicit ``--output-format`` flag and a
+    recognized extension (``.parquet``/``.pq`` → columnar, ``.jsonl``/
+    ``.json``/``.ndjson`` → JSON-lines) both outrank it, so a host-wide
+    default can never mislabel an extensioned file.
+    """
+
+    chunk_size: int = 1024
+    queue_chunks: int = 4
+    pipelined: bool = True
+    output_format: Optional[str] = None   # None | "jsonl" | "parquet"
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None,
+                 variant: Optional[dict] = None) -> "BatchPredictConfig":
+        """Per-knob precedence, weakest first: server.json ``batchpredict``
+        section (``data``) < engine.json ``batchpredict`` section
+        (``variant``) < ``PIO_BATCHPREDICT_*`` env. Malformed knobs are
+        logged and fall back, same contract as ServingConfig."""
+        data = data or {}
+        variant = variant or {}
+        cfg = cls()
+        as_bool = lambda v: str(v).strip().lower() not in (  # noqa: E731
+            "0", "false", "no", "off", "")
+
+        def as_format(v):
+            s = str(v).strip().lower()
+            if s not in ("jsonl", "parquet"):
+                raise ValueError(s)
+            return s
+
+        sources = (
+            ("chunkSize", data.get("chunkSize"), "chunk_size", int),
+            ("queueChunks", data.get("queueChunks"), "queue_chunks", int),
+            ("pipelined", data.get("pipelined"), "pipelined", as_bool),
+            ("outputFormat", data.get("outputFormat"), "output_format",
+             as_format),
+            ("engine.json chunkSize", variant.get("chunkSize"),
+             "chunk_size", int),
+            ("engine.json queueChunks", variant.get("queueChunks"),
+             "queue_chunks", int),
+            ("engine.json pipelined", variant.get("pipelined"),
+             "pipelined", as_bool),
+            ("engine.json outputFormat", variant.get("outputFormat"),
+             "output_format", as_format),
+            ("PIO_BATCHPREDICT_CHUNK_SIZE",
+             os.environ.get("PIO_BATCHPREDICT_CHUNK_SIZE"),
+             "chunk_size", int),
+            ("PIO_BATCHPREDICT_QUEUE_CHUNKS",
+             os.environ.get("PIO_BATCHPREDICT_QUEUE_CHUNKS"),
+             "queue_chunks", int),
+            ("PIO_BATCHPREDICT_PIPELINED",
+             os.environ.get("PIO_BATCHPREDICT_PIPELINED"),
+             "pipelined", as_bool),
+            ("PIO_BATCHPREDICT_OUTPUT_FORMAT",
+             os.environ.get("PIO_BATCHPREDICT_OUTPUT_FORMAT"),
+             "output_format", as_format),
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed batchpredict knob %s=%r",
+                               name, raw)
+        cfg.chunk_size = max(1, cfg.chunk_size)
+        cfg.queue_chunks = max(1, cfg.queue_chunks)
+        return cfg
+
+
+def batchpredict_config(variant_section: Optional[dict] = None
+                        ) -> BatchPredictConfig:
+    """Resolve the batch-scoring knobs a `pio batchpredict` run should
+    use: ``variant_section`` is the engine.json ``batchpredict`` section,
+    which overrides the host-level server.json section; the
+    ``PIO_BATCHPREDICT_*`` env vars override both (the established
+    precedence: env > engine.json > server.json)."""
+    data = read_server_json().get("batchpredict") or {}
+    return BatchPredictConfig.from_env(data, variant_section)
+
+
 DEFAULT_ALS_BLOCK_SIZE = 16
 
 
@@ -385,6 +482,24 @@ class DeployConfig:
         return cfg
 
 
+def read_server_json(path: Optional[str] = None) -> dict:
+    """The raw server.json contents ({} when absent/unreadable) — the
+    shared file read behind ServerConfig.load and the per-section
+    resolvers (batchpredict_config, als_solver_config's TrainConfig)."""
+    if path is None:
+        conf_dir = os.environ.get(
+            "PIO_CONF_DIR", os.path.join(pio_home(), "conf"))
+        path = os.environ.get("PIO_SERVER_CONF",
+                              os.path.join(conf_dir, "server.json"))
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            logger.warning("cannot read server config %s: %s", path, e)
+    return {}
+
+
 @dataclasses.dataclass
 class ServerConfig:
     key: str = ""
@@ -395,22 +510,13 @@ class ServerConfig:
     deploy: DeployConfig = dataclasses.field(default_factory=DeployConfig)
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    batchpredict: BatchPredictConfig = dataclasses.field(
+        default_factory=BatchPredictConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "ServerConfig":
         """Read server.json, overlay env vars; missing file -> defaults."""
-        if path is None:
-            conf_dir = os.environ.get(
-                "PIO_CONF_DIR", os.path.join(pio_home(), "conf"))
-            path = os.environ.get("PIO_SERVER_CONF",
-                                  os.path.join(conf_dir, "server.json"))
-        data = {}
-        if os.path.exists(path):
-            try:
-                with open(path) as f:
-                    data = json.load(f)
-            except (OSError, json.JSONDecodeError) as e:
-                logger.warning("cannot read server config %s: %s", path, e)
+        data = read_server_json(path)
         ssl_conf = data.get("ssl", {}) or {}
         cfg = cls(
             key=data.get("key", "") or "",
@@ -421,6 +527,8 @@ class ServerConfig:
             deploy=DeployConfig.from_env(data.get("deploy") or {}),
             ingest=IngestConfig.from_env(data.get("ingest") or {}),
             train=TrainConfig.from_env(data.get("train") or {}),
+            batchpredict=BatchPredictConfig.from_env(
+                data.get("batchpredict") or {}),
         )
         if os.environ.get("PIO_SERVER_KEY"):
             cfg.key = os.environ["PIO_SERVER_KEY"]

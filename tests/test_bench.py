@@ -247,6 +247,45 @@ def test_bench_als_kernel_smoke(tmp_path):
     assert detail["iters_subspace"] >= detail["iters_full"]
 
 
+def test_bench_batch_predict_smoke(tmp_path):
+    """Smoke the batch_predict config at a shrunken scale: the config
+    itself asserts byte-identical jsonl output, value-identical parquet
+    output (single-process AND 2-shard merged), and the compile-shape
+    ledger bound; the emitted detail must carry the per-path qps +
+    speedup fields the judged run records. The judged-scale throughput
+    floor is 4x (the tentpole bar); the smoke floors are disabled — at
+    smoke scale fixed costs (spawn, first-chunk warmup) swamp the
+    steady-state ratio on a busy 2-core CI box."""
+    p = _run("batch_predict", "300", timeout=280, tmp_path=tmp_path,
+             extra_env={"BENCH_BP_USERS": "400",
+                        "BENCH_BP_ITEMS": "200",
+                        "BENCH_BP_RANK": "8",
+                        "BENCH_BP_QUERIES": "2000",
+                        "BENCH_BP_CHUNK": "256",
+                        "BENCH_BP_NUM": "10",
+                        "BENCH_BP_MIN_SPEEDUP": "0",
+                        "BENCH_BP_MIN_PIPE": "0"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert "batch_predict" in out["unit"]
+    detail = next(d for d in
+                  json.load(open(tmp_path / "details.json"))["details"]
+                  if d["name"] == "batch_predict")
+    for key in ("qps_sequential", "qps_pipelined", "qps_columnar",
+                "qps_sharded_2proc", "speedup_pipelined",
+                "speedup_columnar", "speedup_sharded_2proc",
+                "speedup_headline", "pad_waste_rows",
+                "distinct_compiled_batch_shapes", "compile_shape_bound"):
+        assert key in detail, (key, detail)
+    assert detail["qps_columnar"] > 0
+    # the tentpole contract, visible in the judged artifact: the batch
+    # scorer's compiled shapes stay inside the bucket ladder
+    assert 0 < detail["distinct_compiled_batch_shapes"] \
+        <= detail["compile_shape_bound"]
+
+
 def test_every_bench_config_has_smoke():
     """Static gate: every bench.py config must either have a `_run(...)`
     smoke in this file or a justified HEAVY_EXEMPT entry — future

@@ -108,6 +108,26 @@ def test_cli_full_lifecycle(clienv, tmp_path, monkeypatch):
     for ln in lines:
         assert len(ln["prediction"]["itemScores"]) == 3   # quickstart assert
 
+    # release selection + knobs: scoring with the registered release v1
+    # at a forced chunk size must answer the same
+    preds2 = tmp_path / "preds2.json"
+    out = _ok(r.invoke(cli, ["batchpredict", "--input", str(queries),
+                             "--output", str(preds2), "--release", "v1",
+                             "--chunk-size", "2",
+                             "--output-format", "jsonl"]))
+    assert "Scoring with release v1" in out
+    assert "Wrote 5 predictions" in out
+    lines2 = [json.loads(ln) for ln in preds2.read_text().splitlines()]
+    # same instance, so the same items in the same order (scores may
+    # differ in the last float32 bits across BLAS batch shapes)
+    assert ([[s["item"] for s in ln["prediction"]["itemScores"]]
+             for ln in lines2]
+            == [[s["item"] for s in ln["prediction"]["itemScores"]]
+                for ln in lines])
+    out = r.invoke(cli, ["batchpredict", "--input", str(queries),
+                         "--output", str(preds2), "--release", "v99"])
+    assert out.exit_code != 0 and "not found" in out.output
+
     # export round-trips the imported events
     exported = tmp_path / "export.json"
     out = _ok(r.invoke(cli, ["export", "--appname", "cliapp",

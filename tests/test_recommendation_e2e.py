@@ -171,8 +171,9 @@ def test_batch_predict(app_with_ratings, tmp_path):
     inp.write_text('{"user": "u1", "num": 3}\n{"user": "u2", "num": 2}\n')
     from predictionio_tpu.workflow.batch_predict import run_batch_predict
 
-    n = run_batch_predict(engine, instance, str(inp), str(out))
-    assert n == 2
+    report = run_batch_predict(engine, instance, str(inp), str(out))
+    assert report.written == report.total_written == 2
+    assert report.invalid == 0 and report.merged
     lines = [json.loads(x) for x in out.read_text().splitlines()]
     assert lines[0]["query"] == {"user": "u1", "num": 3}
     assert len(lines[0]["prediction"]["itemScores"]) == 3

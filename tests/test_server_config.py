@@ -45,6 +45,59 @@ def test_check_key():
     assert cfg.check_key(None) is False
 
 
+def test_batchpredict_section_defaults_and_file(tmp_path, monkeypatch):
+    for var in ("PIO_BATCHPREDICT_CHUNK_SIZE", "PIO_BATCHPREDICT_PIPELINED",
+                "PIO_BATCHPREDICT_QUEUE_CHUNKS",
+                "PIO_BATCHPREDICT_OUTPUT_FORMAT"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("PIO_SERVER_CONF", str(tmp_path / "absent.json"))
+    cfg = ServerConfig.load().batchpredict
+    assert (cfg.chunk_size, cfg.queue_chunks, cfg.pipelined,
+            cfg.output_format) == (1024, 4, True, None)
+
+    conf = tmp_path / "server.json"
+    conf.write_text(json.dumps({"batchpredict": {
+        "chunkSize": 256, "queueChunks": 2, "pipelined": False,
+        "outputFormat": "parquet"}}))
+    monkeypatch.setenv("PIO_SERVER_CONF", str(conf))
+    cfg = ServerConfig.load().batchpredict
+    assert (cfg.chunk_size, cfg.queue_chunks, cfg.pipelined,
+            cfg.output_format) == (256, 2, False, "parquet")
+
+
+def test_batchpredict_precedence_env_over_variant_over_file(
+        tmp_path, monkeypatch):
+    """The established knob precedence: PIO_BATCHPREDICT_* env >
+    engine.json batchpredict section > server.json batchpredict
+    section; malformed values are ignored, not fatal."""
+    from predictionio_tpu.utils.server_config import batchpredict_config
+
+    conf = tmp_path / "server.json"
+    conf.write_text(json.dumps({"batchpredict": {
+        "chunkSize": 100, "queueChunks": 7, "outputFormat": "parquet"}}))
+    monkeypatch.setenv("PIO_SERVER_CONF", str(conf))
+    for var in ("PIO_BATCHPREDICT_CHUNK_SIZE", "PIO_BATCHPREDICT_PIPELINED",
+                "PIO_BATCHPREDICT_QUEUE_CHUNKS",
+                "PIO_BATCHPREDICT_OUTPUT_FORMAT"):
+        monkeypatch.delenv(var, raising=False)
+
+    # engine.json section beats server.json where set
+    cfg = batchpredict_config({"chunkSize": 200})
+    assert cfg.chunk_size == 200 and cfg.queue_chunks == 7
+    assert cfg.output_format == "parquet"
+
+    # env beats both; malformed env/section values fall through
+    monkeypatch.setenv("PIO_BATCHPREDICT_CHUNK_SIZE", "300")
+    monkeypatch.setenv("PIO_BATCHPREDICT_OUTPUT_FORMAT", "tsv")  # invalid
+    cfg = batchpredict_config({"chunkSize": 200, "queueChunks": "many"})
+    assert cfg.chunk_size == 300
+    assert cfg.queue_chunks == 7          # malformed variant ignored
+    assert cfg.output_format == "parquet"  # malformed env ignored
+    # floors: nonsense values can't wedge the pipeline
+    monkeypatch.setenv("PIO_BATCHPREDICT_CHUNK_SIZE", "-5")
+    assert batchpredict_config(None).chunk_size == 1
+
+
 def test_ssl_context_from_self_signed_cert(tmp_path):
     cert, key = tmp_path / "c.pem", tmp_path / "k.pem"
     p = subprocess.run(

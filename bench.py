@@ -55,6 +55,11 @@ Configs (order = bank cheap+judged numbers first, riskiest last):
   ingest_write      event WRITE hot path: per-request inserts vs the
                     group-commit WriteBuffer on sqlite + parquet,
                     events/s + ack p99 (asserts >=5x and exactly-once)
+  foldin_freshness  online fold-in loop: batched vs one-at-a-time
+                    fold-ins/sec (asserts >=5x + bounded als_foldin
+                    ledger) and open-loop event stream vs recommendation
+                    probe, p50/p95 event->reflected seconds (asserts
+                    p95 <= apply interval + one warm apply + slack)
   batch_predict     offline batch scoring: sequential-chunk loop vs the
                     pipelined reader->scorer->writer vs a 2-process
                     sharded fleet, queries/s (asserts >=4x best path,
@@ -1665,6 +1670,243 @@ def cfg_ingest_write(jax, mesh, platform):
     return detail
 
 
+def cfg_foldin_freshness(jax, mesh, platform):
+    """Online fold-in: the event→serving freshness loop (deploy/foldin.py).
+
+    Two measurements:
+
+    1. **fold-ins/sec, batched vs one-at-a-time** — the same
+       `FoldInSolver` solves B pending user rows as ONE bucketed device
+       program vs B single-row dispatches. The batched path's win is the
+       tentpole bar (>= BENCH_FOLDIN_MIN_SPEEDUP, default 5x): per-row
+       dispatch overhead is exactly what an online path cannot afford.
+       Also asserts the `als_foldin` compile ledger stays inside the
+       power-of-two bucket ladder.
+    2. **p50/p95 event→reflected seconds** — an open-loop event stream
+       (new users' rate events submitted through the group-commit
+       WriteBuffer with the fold-in push tap armed) races a
+       recommendation PROBE that polls the query server's predict path
+       until each user appears; the controller applies on a timer
+       thread at BENCH_FOLDIN_INTERVAL_S. Asserts the headline bound:
+       p95 <= apply interval + one (warm) apply + slack.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from predictionio_tpu.core.engine import TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event, UTC
+    from predictionio_tpu.data.write_buffer import WriteBuffer
+    from predictionio_tpu.deploy.foldin import FoldInController
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, DataSourceParams, Query,
+        RecommendationServing)
+    from predictionio_tpu.models.als import ALSModel, ALSParams, FoldInSolver
+    from predictionio_tpu.ops.bucketing import bucket_count
+    from predictionio_tpu.ops.fn_cache import family_keys
+    from predictionio_tpu.server.query_server import QueryServer
+    from predictionio_tpu.storage.base import App, EngineInstance
+    from predictionio_tpu.storage.registry import Storage
+    from predictionio_tpu.utils.server_config import (
+        DeployConfig, FoldinConfig, ServingConfig)
+    import datetime as dt
+
+    total_t0 = time.perf_counter()
+    nu = int(os.environ.get("BENCH_FOLDIN_USERS", 3000))
+    ni = int(os.environ.get("BENCH_FOLDIN_ITEMS", 1500))
+    rank = int(os.environ.get("BENCH_FOLDIN_RANK", 32))
+    solve_batch = int(os.environ.get("BENCH_FOLDIN_SOLVE_BATCH", 256))
+    ratings_per = int(os.environ.get("BENCH_FOLDIN_EVENTS_PER_USER", 8))
+    stream_users = int(os.environ.get("BENCH_FOLDIN_STREAM_USERS", 120))
+    interval_s = float(os.environ.get("BENCH_FOLDIN_INTERVAL_S", 0.25))
+    min_speedup = float(os.environ.get("BENCH_FOLDIN_MIN_SPEEDUP", 5))
+    p95_slack = float(os.environ.get("BENCH_FOLDIN_P95_SLACK", 0.5))
+    detail = {"rank": rank, "solve_batch": solve_batch,
+              "apply_interval_s": interval_s,
+              "stream_users": stream_users,
+              "events_per_user": ratings_per}
+    rng = np.random.default_rng(17)
+
+    # ---- 1) batched vs one-at-a-time fold-ins/sec ------------------------
+    hb("foldin solver warmup")
+    V = rng.normal(size=(ni, rank)).astype(np.float32)
+    params = ALSParams(rank=rank, reg=0.05)
+    solver = FoldInSolver(V, params)
+    rated = [rng.choice(ni, size=ratings_per, replace=False)
+             for _ in range(solve_batch)]
+    values = [np.clip(rng.normal(3.0, 1.0, ratings_per), 1, 5
+                      ).astype(np.float32) for _ in range(solve_batch)]
+    solver.solve(rated, values)                   # compile batched shape
+    solver.solve(rated[:1], values[:1])           # compile B=1 shape
+    hb("foldin solver timed")
+
+    def time_batched():
+        t0 = time.perf_counter()
+        solver.solve(rated, values)
+        return solve_batch / (time.perf_counter() - t0)
+
+    def time_sequential():
+        t0 = time.perf_counter()
+        for r, v in zip(rated, values):
+            solver.solve([r], [v])
+        return solve_batch / (time.perf_counter() - t0)
+
+    fps_batched = max(time_batched() for _ in range(2))
+    fps_seq = max(time_sequential() for _ in range(2))
+    speedup = fps_batched / fps_seq
+    ledger = [k for k in family_keys("als_foldin")
+              if k[0] == (ni, rank)]
+    ledger_bound = 2 * bucket_count(solve_batch) + 2
+    detail.update({
+        "foldins_per_s_batched": round(fps_batched, 1),
+        "foldins_per_s_sequential": round(fps_seq, 1),
+        "speedup_batched": round(speedup, 2),
+        "foldin_compiled_shapes": len(ledger),
+        "foldin_shape_bound": ledger_bound,
+    })
+    assert 0 < len(ledger) <= ledger_bound, (len(ledger), ledger_bound)
+    assert speedup >= min_speedup, (
+        f"batched fold-in {speedup:.1f}x < {min_speedup}x over "
+        "one-at-a-time")
+
+    # ---- 2) open-loop event stream vs recommendation probe ---------------
+    hb("foldin freshness loop")
+    root = tempfile.mkdtemp(prefix="pio_bench_foldin_")
+    try:
+        Storage.configure({
+            "sources": {"DB": {"TYPE": "sqlite",
+                               "PATH": f"{root}/events.db"}},
+            "repositories": {
+                "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+                "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+                "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+            }})
+        app_id = Storage.get_meta_data_apps().insert(
+            App(id=0, name="FoldinBench"))
+        Storage.get_events().init_channel(app_id)
+        model = ALSModel(
+            user_vocab=np.asarray([f"u{i:06d}" for i in range(nu)],
+                                  dtype=object),
+            item_vocab=np.asarray([f"i{i:06d}" for i in range(ni)],
+                                  dtype=object),
+            U=rng.normal(size=(nu, rank)).astype(np.float32),
+            V=V)
+        result = TrainResult(
+            models=[model],
+            algorithms=[ALSAlgorithm(AlgorithmParams(rank=rank))],
+            serving=RecommendationServing(),
+            engine_params=EngineParams(
+                data_source_params=DataSourceParams(
+                    app_name="FoldinBench")))
+        instance = EngineInstance(
+            id="foldin-bench", engine_id="bench", engine_version="1",
+            engine_variant="default", status="COMPLETED")
+        server = QueryServer(
+            None, result, instance, ctx=None,
+            serving_config=ServingConfig(batch_max=16, batch_linger_s=0.0),
+            deploy_config=DeployConfig(warmup=False))
+        ctl = FoldInController(
+            server, FoldinConfig(enabled=True,
+                                 apply_interval_s=interval_s,
+                                 max_pending=4 * stream_users),
+            registry=server.registry)
+        ctl.start()                       # arms the push tap (no loop)
+        buf = WriteBuffer(linger_s=0.001, flush_max=256)
+
+        stop = threading.Event()
+        apply_s: list = []
+
+        def apply_loop():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    out = ctl.apply_pending()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+                    out = None
+                if out is not None:
+                    apply_s.append(time.perf_counter() - t0)
+                stop.wait(interval_s)
+
+        applier = threading.Thread(target=apply_loop, daemon=True)
+        applier.start()
+
+        def stream_one(uid: str):
+            when = dt.datetime.now(tz=UTC)
+            items = rng.choice(ni, size=ratings_per, replace=False)
+            evs = [Event(event="rate", entity_type="user", entity_id=uid,
+                         target_entity_type="item",
+                         target_entity_id=f"i{j:06d}",
+                         properties=DataMap({"rating": 4.0}),
+                         event_time=when) for j in items]
+            buf.submit(evs, app_id)
+            return time.monotonic()
+
+        def probe_until(uid: str, deadline_s: float = 60.0):
+            q = Query(user=uid, num=10)
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if server._predict(q).item_scores:
+                    return time.monotonic()
+                time.sleep(0.002)
+            raise AssertionError(f"user {uid} never reflected")
+
+        # warm the streaming shapes (first applies pay XLA compiles)
+        for w in range(2):
+            t0 = stream_one(f"warm{w:04d}")
+            probe_until(f"warm{w:04d}")
+        apply_s.clear()
+
+        lat: list = []
+        for n in range(stream_users):
+            t_post = stream_one(f"fresh{n:05d}")
+            # open loop: a new user every few ms, several per apply tick
+            time.sleep(0.004)
+            if n % 4 == 3:      # probe a sample of the stream, inline
+                t_ref = probe_until(f"fresh{n:05d}")
+                lat.append(t_ref - t_post)
+        # drain: every streamed user must reflect
+        t_ref = probe_until(f"fresh{stream_users - 1:05d}")
+        stop.set()
+        applier.join(timeout=10)
+        ctl.stop_tap()
+        buf.stop()
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        p95 = lat[min(len(lat) - 1, int(0.95 * len(lat)))]
+        max_apply = max(apply_s) if apply_s else 0.0
+        bound = interval_s + max_apply + p95_slack
+        detail.update({
+            "p50_event_to_reflected_s": round(p50, 4),
+            "p95_event_to_reflected_s": round(p95, 4),
+            "max_warm_apply_s": round(max_apply, 4),
+            "p95_bound_s": round(bound, 4),
+            "applies": ctl.applies,
+            "applied_user_rows": ctl.applied_users,
+        })
+        assert ctl.applied_users >= stream_users
+        assert p95 <= bound, (
+            f"p95 event->reflected {p95:.3f}s exceeds bound {bound:.3f}s "
+            f"(interval {interval_s}s + apply {max_apply:.3f}s + slack)")
+    finally:
+        Storage.reset()
+        shutil.rmtree(root, ignore_errors=True)
+    detail["elapsed_s"] = round(time.perf_counter() - total_t0, 2)
+    detail["speedup_headline"] = detail["speedup_batched"]
+    detail["note"] = (
+        f"online fold-in: batched solve {fps_batched:.0f} rows/s vs "
+        f"{fps_seq:.0f} one-at-a-time ({speedup:.1f}x, B={solve_batch} "
+        f"r{rank}); event->reflected p50 {p50 * 1000:.0f}ms / p95 "
+        f"{p95 * 1000:.0f}ms at {interval_s}s apply interval "
+        f"({stream_users} streamed users, {ctl.applies} applies); "
+        f"{len(ledger)} compiled shapes (bound {ledger_bound})")
+    return detail
+
+
 def _batchpredict_result(nu, ni, rank, seed=11):
     """Synthetic trained recommendation engine (no storage, no train):
     the deterministic fixture shared by the parent bench AND the sharded
@@ -2041,6 +2283,7 @@ CONFIGS = {
     "deploy_swap": (cfg_deploy_swap, 240),
     "train_ingest": (cfg_train_ingest, 240),
     "ingest_write": (cfg_ingest_write, 240),
+    "foldin_freshness": (cfg_foldin_freshness, 240),
     "batch_predict": (cfg_batch_predict, 300),
     "als_ml20m": (cfg_als_ml20m, 900),
 }

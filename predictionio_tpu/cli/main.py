@@ -426,11 +426,22 @@ def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
     click.echo(f"[INFO] Deploying engine instance {instance.id}"
                + (f" (release v{release.version})" if release else "")
                + f" at {ip}:{port}")
+    # online fold-in knobs: env > engine.json "foldin" > server.json
+    from predictionio_tpu.utils.server_config import foldin_config
+    fic = foldin_config((_vj or {}).get("foldin"))
+    if fic.enabled:
+        click.echo(f"[INFO] Online fold-in enabled: apply interval "
+                   f"{fic.apply_interval_s:g}s, max pending "
+                   f"{fic.max_pending} rows")
+    else:
+        click.echo("[INFO] Online fold-in disabled (enable via engine.json "
+                   '{"foldin": {"enabled": true}} or PIO_FOLDIN=1)')
     result, ctx = load_for_deploy(engine, instance)
     run_query_server(engine, result, instance, ctx, ip=ip, port=port,
                      feedback=feedback, feedback_app_name=event_server_app,
                      access_key=accesskey, log_url=log_url,
-                     log_prefix=log_prefix, release=release)
+                     log_prefix=log_prefix, release=release,
+                     foldin_config=fic)
 
 
 def _release_of_instance(engine_id, variant_id, instance_id):

@@ -50,6 +50,44 @@ from predictionio_tpu.storage.base import StorageError, generate_id
 
 logger = logging.getLogger("pio.writebuffer")
 
+#: flush taps: callables invoked AFTER a group commit durably lands,
+#: with (events, app_id, channel_id). This is the event-write-path push
+#: seam the online fold-in subsystem rides (deploy/foldin.py): an
+#: in-process query server learns about fresh events the moment they
+#: are acknowledged, without polling. Module-level (not per-buffer) so
+#: a subscriber never has to know WHICH buffer the event server built.
+#: Taps run on the writer thread — they must be cheap (mark-and-return)
+#: and may never raise into the flush (failures are logged and dropped);
+#: durability and the caller's ack do not depend on them.
+_FLUSH_TAPS: List[Callable] = []
+_TAPS_LOCK = threading.Lock()
+
+
+def add_flush_tap(tap: Callable) -> None:
+    """Subscribe `tap(events, app_id, channel_id)` to successful group
+    commits of EVERY WriteBuffer in this process."""
+    with _TAPS_LOCK:
+        if tap not in _FLUSH_TAPS:
+            _FLUSH_TAPS.append(tap)
+
+
+def remove_flush_tap(tap: Callable) -> None:
+    with _TAPS_LOCK:
+        try:
+            _FLUSH_TAPS.remove(tap)
+        except ValueError:
+            pass
+
+
+def _notify_taps(events, app_id, channel_id) -> None:
+    with _TAPS_LOCK:
+        taps = list(_FLUSH_TAPS)
+    for tap in taps:
+        try:
+            tap(events, app_id, channel_id)
+        except Exception:
+            logger.exception("flush tap failed (events stay committed)")
+
 
 class BufferFull(Exception):
     """The bounded ingest queue cannot accept more events right now.
@@ -256,6 +294,10 @@ class WriteBuffer:
                 if p.future.set_running_or_notify_cancel():
                     p.future.set_result(list(ids[pos:pos + n]))
                 pos += n
+            # push the committed events to the in-process subscribers
+            # (online fold-in): only AFTER the durable commit, so a tap
+            # can never observe an event the store might still lose
+            _notify_taps(events, app_id, channel_id)
         # feed the Retry-After estimate with the observed flush time
         self._last_flush_s = max(0.001, time.monotonic() - t0)
         if self._flush_duration is not None:

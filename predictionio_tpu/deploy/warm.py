@@ -37,6 +37,13 @@ from predictionio_tpu.storage.base import EngineInstance, Release
 logger = logging.getLogger("pio.deploy")
 
 
+class FoldinSwapRaced(Exception):
+    """A fold-in drift lost the cutover race: the serving unit changed
+    (reload/deploy/rollback/canary) between the solve's snapshot and the
+    swap. The apply requeues its deltas and the next tick folds them
+    onto whatever is live — never silently reverting a real deploy."""
+
+
 class DeployError(Exception):
     """A release failed to become servable (load/warmup/verify)."""
 
@@ -58,6 +65,13 @@ class ServingUnit:
     vectorized: bool
     release: Optional[Release] = None
     batcher: Any = None
+    #: the pre-fold-in BASE unit when this unit is an online fold-in
+    #: drift of it (deploy/foldin.py): kept resident so rollback
+    #: restores pre-fold-in answers instantly, however many applies
+    #: have stacked since the real deploy
+    foldin_of: Optional["ServingUnit"] = None
+    #: factor rows folded into this unit since its base was deployed
+    foldin_rows: int = 0
 
     @property
     def release_version(self) -> int:

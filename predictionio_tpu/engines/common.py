@@ -68,6 +68,134 @@ def item_meta_join(item_vocab, items: Dict[str, Item]) -> Dict[int, Item]:
     return {int(ix): items[str(k)] for ix, k in zip(idxs, ids) if ix >= 0}
 
 
+class EntityEventCache:
+    """Short-TTL per-entity cache over the COLUMNAR event find path —
+    the serving-time business-rule lookup (e-commerce unseen-only /
+    recent-items / unavailable-items rules).
+
+    The reference (and the pre-PR rebuild) issued a row-at-a-time
+    ``LEventStore.find_by_entity`` per query, materializing an Event
+    object per row on the hot path. Here each lookup is ONE projected
+    columnar read decoded straight to target-id arrays, and repeated
+    lookups for the same entity inside ``ttl_s`` are served from memory
+    — a burst of queries for one busy user costs one storage read per
+    TTL window instead of one per query. Hits/misses are counted per
+    lookup kind in ``pio_serving_entity_cache_{hits,misses}_total``.
+
+    The TTL is deliberately short (default 1s, ``PIO_ENTITY_CACHE_TTL_S``):
+    staleness is bounded at "a just-viewed item may be recommended for
+    up to ttl_s more", which the reference's uncached path never
+    promised better than its own query latency anyway.
+    """
+
+    MAX_ENTRIES = 4096
+
+    def __init__(self, app_name: str, channel_name: Optional[str] = None,
+                 ttl_s: Optional[float] = None, registry=None):
+        import os
+        import threading
+
+        from predictionio_tpu.obs.foldin_stats import (
+            entity_cache_hits, entity_cache_misses,
+        )
+
+        self.app_name = app_name
+        self.channel_name = channel_name
+        if ttl_s is None:
+            try:
+                ttl_s = float(os.environ.get("PIO_ENTITY_CACHE_TTL_S", "1.0"))
+            except ValueError:
+                ttl_s = 1.0
+        self.ttl_s = max(0.0, ttl_s)
+        self._lock = threading.Lock()
+        self._cache: dict = {}
+        self._hits = entity_cache_hits(registry)
+        self._misses = entity_cache_misses(registry)
+
+    def _get(self, key, lookup: str):
+        import time
+
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None and time.monotonic() - hit[0] < self.ttl_s:
+                self._hits.inc(lookup=lookup)
+                return hit[1]
+        self._misses.inc(lookup=lookup)
+        return None
+
+    def _put(self, key, value) -> None:
+        import time
+
+        with self._lock:
+            if len(self._cache) >= self.MAX_ENTRIES:
+                self._cache.clear()     # TTL entries: wholesale reset is fine
+            self._cache[key] = (time.monotonic(), value)
+        return None
+
+    def targets(self, entity_type: str, entity_id: str, event_names,
+                target_entity_type: Optional[str] = None,
+                limit: Optional[int] = None, latest: bool = True,
+                lookup: str = "targets") -> "tuple":
+        """Distinct target entity ids of the entity's matching events
+        (latest-first when `limit` bounds the read) — the columnar
+        replacement for the per-event find_by_entity loops."""
+        from predictionio_tpu.data.eventstore import EventStoreClient
+        from predictionio_tpu.data.ingest import event_columns
+
+        names = tuple(event_names)
+        key = ("targets", entity_type, entity_id, names,
+               target_entity_type, limit, latest)
+        cached = self._get(key, lookup)
+        if cached is not None:
+            return cached
+        kwargs = dict(entity_type=entity_type, entity_id=entity_id,
+                      event_names=list(names), ordered=bool(limit),
+                      columns=("target_entity_id",))
+        if target_entity_type is not None:
+            kwargs["target_entity_type"] = target_entity_type
+        if limit is not None and limit > 0:
+            kwargs["limit"] = limit
+            kwargs["reversed_order"] = latest
+        table = EventStoreClient.find_columnar(
+            self.app_name, self.channel_name, **kwargs)
+        tids, = event_columns(table, "target_entity_id")
+        seen, out = set(), []
+        for t in tids:
+            if t is not None and t not in seen:
+                seen.add(t)
+                out.append(t)
+        value = tuple(out)
+        self._put(key, value)
+        return value
+
+    def latest_properties(self, entity_type: str, entity_id: str,
+                          event_names, lookup: str = "constraint"):
+        """The latest matching event's properties dict (None when the
+        entity has no such event) — the unavailable-items constraint
+        read."""
+        import json
+
+        from predictionio_tpu.data.eventstore import EventStoreClient
+        from predictionio_tpu.data.ingest import event_columns
+
+        names = tuple(event_names)
+        key = ("props", entity_type, entity_id, names)
+        cached = self._get(key, lookup)
+        if cached is not None:
+            return cached[0]
+        table = EventStoreClient.find_columnar(
+            self.app_name, self.channel_name, entity_type=entity_type,
+            entity_id=entity_id, event_names=list(names), limit=1,
+            reversed_order=True, columns=("properties",))
+        props = None
+        if table.num_rows:
+            raw, = event_columns(table, "properties")
+            props = json.loads(raw[0]) if raw[0] else {}
+        # wrap in a tuple so a cached None is distinguishable from a miss
+        self._put(key, (props,))
+        return props
+
+
 def resolved_als_solver(algo_params, logger) -> "tuple[str, int]":
     """Resolve + log the ALS training solver for an engine's train().
 

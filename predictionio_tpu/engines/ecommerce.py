@@ -28,11 +28,15 @@ from predictionio_tpu.core import Engine, EngineParams, FirstServing, Params, Pr
 from predictionio_tpu.core.base import Algorithm, DataSource
 from predictionio_tpu.data.bimap import assign_indices, vocab_index
 from predictionio_tpu.engines.common import (
-    InteractionColumns, Item, ItemScore, PredictedResult, categories_match,
-    item_meta_join, resolved_als_solver,
+    EntityEventCache, InteractionColumns, Item, ItemScore, PredictedResult,
+    categories_match, item_meta_join, resolved_als_solver,
 )
-from predictionio_tpu.data.eventstore import EventStoreClient
 from predictionio_tpu.models.als import ALSData, ALSParams, train_als
+
+#: training-time implicit confidence weights (genMLlibRating parity:
+#: a buy is worth BUY_WEIGHT views) — shared with the fold-in spec so
+#: the online path can never drift from the training semantics
+VIEW_WEIGHT, BUY_WEIGHT = 1.0, 2.0
 
 logger = logging.getLogger("pio.engine.ecommerce")
 
@@ -172,8 +176,8 @@ class ECommAlgorithm(Algorithm):
         all_users = np.concatenate([pd.views.users, pd.buys.users])
         all_items = np.concatenate([pd.views.items, pd.buys.items])
         weights = np.concatenate([
-            np.ones(len(pd.views), np.float32),
-            np.full(len(pd.buys), 2.0, np.float32)])
+            np.full(len(pd.views), VIEW_WEIGHT, np.float32),
+            np.full(len(pd.buys), BUY_WEIGHT, np.float32)])
         users, items, values = pair_counts(all_users, all_items, weights)
         if not len(values):
             raise ValueError("view/buy events cannot be empty")
@@ -201,40 +205,43 @@ class ECommAlgorithm(Algorithm):
                           popular_count=popular)
 
     # -- serving-time business rules -----------------------------------------
+    def _event_cache(self) -> EntityEventCache:
+        """Lazy short-TTL per-entity lookup cache (engines/common.py):
+        the business-rule reads below ride the COLUMNAR find path — one
+        projected scan decoded to id arrays instead of a row-at-a-time
+        Event materialization per query — and repeat lookups within the
+        TTL cost no storage read at all. Hit/miss counts land in
+        ``pio_serving_entity_cache_*`` (OBSERVABILITY.md)."""
+        cache = getattr(self, "_entity_cache", None)
+        if cache is None:
+            cache = EntityEventCache(self.params.app_name)
+            self._entity_cache = cache
+        return cache
+
     def _gen_black_list(self, query: Query) -> Set[str]:
         """genBlackList parity (:319-384): seen + unavailable + query black."""
         # a misconfigured app_name must surface, not silently disable the
         # business rules (the reference only tolerates store timeouts,
         # ECommAlgorithm.scala:330-339)
+        cache = self._event_cache()
         seen: Set[str] = set()
         if self.params.unseen_only:
-            for e in EventStoreClient.find_by_entity(
-                    app_name=self.params.app_name,
-                    entity_type="user", entity_id=query.user,
-                    event_names=list(self.params.seen_events),
-                    target_entity_type="item", limit=-1):
-                if e.target_entity_id:
-                    seen.add(e.target_entity_id)
+            seen = set(cache.targets(
+                "user", query.user, self.params.seen_events,
+                target_entity_type="item", lookup="seen"))
         unavailable: Set[str] = set()
-        events = list(EventStoreClient.find_by_entity(
-            app_name=self.params.app_name,
-            entity_type="constraint", entity_id="unavailableItems",
-            event_names=["$set"], limit=1, latest=True))
-        if events:
-            unavailable = set(events[0].properties.get("items", list))
+        props = cache.latest_properties(
+            "constraint", "unavailableItems", ["$set"], lookup="constraint")
+        if props:
+            unavailable = set(props.get("items") or [])
         return seen | unavailable | set(query.black_list or ())
 
     def _recent_items(self, query: Query) -> Set[str]:
         """getRecentItems parity (:386-427): user's latest similar-events."""
-        out: Set[str] = set()
-        for e in EventStoreClient.find_by_entity(
-                app_name=self.params.app_name,
-                entity_type="user", entity_id=query.user,
-                event_names=list(self.params.similar_events),
-                target_entity_type="item", limit=10, latest=True):
-            if e.target_entity_id:
-                out.add(e.target_entity_id)
-        return out
+        return set(self._event_cache().targets(
+            "user", query.user, self.params.similar_events,
+            target_entity_type="item", limit=10, latest=True,
+            lookup="recent_items"))
 
     def _candidate_mask(self, model: ECommModel, query: Query,
                         black: Set[str]) -> np.ndarray:
@@ -274,6 +281,54 @@ class ECommAlgorithm(Algorithm):
         if model is None or not len(model.user_vocab):
             return None
         return Query(user=str(model.user_vocab[0]), num=10)
+
+    # -- online fold-in (deploy/foldin.py) -----------------------------------
+    def foldin_spec(self, model: ECommModel, engine_params):
+        """Fold-in contract: view/buy events re-solve the user's
+        implicit-ALS row (pair weights summed exactly like the training
+        read's `pair_counts`), and buy events delta-merge into the
+        popularity counts behind the unknown-user fallback. Items stay
+        frozen — their metadata/constraint lifecycle needs a retrain."""
+        from predictionio_tpu.deploy.foldin import FoldinSpec
+
+        if model is None:
+            return None
+        return FoldinSpec(
+            app_name=self.params.app_name,
+            als_params=ALSParams(
+                rank=self.params.rank, reg=self.params.reg,
+                alpha=self.params.alpha, implicit_prefs=True,
+                seed=self.params.seed),
+            event_names=("view", "buy"),
+            event_weights={"view": VIEW_WEIGHT, "buy": BUY_WEIGHT},
+            rate_event=None, aggregate="sum", fold_items=False,
+            count_events=("buy",))
+
+    def foldin_factors(self, model: ECommModel):
+        from predictionio_tpu.deploy.foldin import FoldinFactors
+
+        return FoldinFactors(user_vocab=model.user_vocab,
+                             item_vocab=model.item_vocab,
+                             U=model.U, V=model.V)
+
+    def foldin_apply(self, model: ECommModel, spec, user_rows,
+                     item_rows, counts) -> ECommModel:
+        """New model with folded user rows + buy-count delta-merges;
+        everything item-side (V, normalized V, metadata, vocab) is
+        shared by reference — the swap stays cheap at any catalog."""
+        from predictionio_tpu.deploy.foldin import upsert_factor_rows
+
+        user_vocab, U = upsert_factor_rows(model.user_vocab, model.U,
+                                           user_rows)
+        popular = model.popular_count
+        if counts:
+            popular = dict(popular)
+            for iid, delta in counts.items():
+                idx = model.item_index(str(iid))
+                if idx is not None:     # brand-new items need a retrain
+                    popular[idx] = int(popular.get(idx, 0) + delta)
+        return dataclasses.replace(model, user_vocab=user_vocab, U=U,
+                                   popular_count=popular)
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         black = self._gen_black_list(query)

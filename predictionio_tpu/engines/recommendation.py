@@ -359,6 +359,61 @@ class ALSAlgorithm(Algorithm):
             return None
         return Query(user=str(model.user_vocab[0]), num=10)
 
+    # -- online fold-in (deploy/foldin.py) -----------------------------------
+    def foldin_spec(self, model: ALSModel, engine_params):
+        """Fold-in contract: the SAME event→rating mapping the training
+        read uses (rate keeps its rating property; buy/view weigh per
+        DataSourceParams), each event one rating row, and BOTH sides
+        fold — a fresh item's row is solved from its raters against the
+        updated user factors."""
+        from predictionio_tpu.deploy.foldin import FoldinSpec
+
+        ds = getattr(engine_params, "data_source_params", None)
+        app_name = getattr(ds, "app_name", None)
+        if model is None or not app_name:
+            return None
+        names = tuple(getattr(ds, "event_names", None) or ["rate", "buy"])
+        weights = {**RecommendationDataSource.DEFAULT_WEIGHTS,
+                   **(getattr(ds, "event_weights", None) or {})}
+        return FoldinSpec(
+            app_name=app_name,
+            als_params=ALSParams(
+                rank=self.params.rank, reg=self.params.reg,
+                alpha=self.params.alpha,
+                implicit_prefs=self.params.implicit_prefs,
+                seed=self.params.seed),
+            event_names=names, event_weights=weights,
+            rate_event="rate" if "rate" in names else None,
+            aggregate="rows", fold_items=True)
+
+    def foldin_factors(self, model: ALSModel):
+        from predictionio_tpu.deploy.foldin import FoldinFactors
+
+        return FoldinFactors(user_vocab=model.user_vocab,
+                             item_vocab=model.item_vocab,
+                             U=model.U, V=model.V,
+                             V_device=model.V_device)
+
+    def foldin_apply(self, model: ALSModel, spec, user_rows, item_rows,
+                     counts) -> ALSModel:
+        from predictionio_tpu.deploy.foldin import upsert_factor_rows
+
+        user_vocab, U = upsert_factor_rows(model.user_vocab, model.U,
+                                           user_rows)
+        item_vocab, V = upsert_factor_rows(model.item_vocab, model.V,
+                                           item_rows)
+        new = ALSModel(user_vocab=user_vocab, item_vocab=item_vocab,
+                       U=U, V=V)
+        # carry the resident device copy of V across the drift: the
+        # V_device cache is per-instance but keyed on V's identity, so a
+        # user-only fold (V unchanged) keeps serving the already-
+        # uploaded array instead of re-uploading the whole catalog every
+        # apply tick; an item fold changes V and re-uploads as it must
+        resident = getattr(model, "_resident", None)
+        if resident is not None:
+            new._resident = resident
+        return new
+
     #: device metric kinds `sweep_eval` can compute
     SWEEP_KINDS = ("precision_at_k", "topn_mse", "zero")
 

@@ -1309,3 +1309,174 @@ def rmse(model_U: np.ndarray, model_V: np.ndarray, user_idx: np.ndarray,
     """Held-out RMSE of r_hat = u . v (the judged metric)."""
     pred = np.einsum("nk,nk->n", model_U[user_idx], model_V[item_idx])
     return float(np.sqrt(np.mean((pred - ratings) ** 2)))
+
+
+# ---------------------------------------------------------------------------
+# Online fold-in (deploy/foldin.py): batched single-side row solves
+# ---------------------------------------------------------------------------
+
+#: compile-ledger family of the online fold-in solver: one entry per
+#: distinct (factor shape, segment bucket, row bucket, row_len, mode)
+#: program — bounded by the power-of-two bucket ladders, never by the
+#: number of applies (the als_topk discipline applied to fold-in)
+FOLDIN_FAMILY = "als_foldin"
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_segments", "implicit_prefs",
+                              "weighted_reg", "alpha_is_zero", "chunk_rows"))
+def _foldin_solve(factors, gram_all, row_tgt, row_seg, row_val, row_w,
+                  reg, alpha, *, num_segments: int, implicit_prefs: bool,
+                  weighted_reg: bool, alpha_is_zero: bool,
+                  chunk_rows: int) -> jax.Array:
+    """Solve `num_segments` rows' normal equations against the frozen
+    `factors` in one batched program — `_half_sweep_dyn`'s math with the
+    global Gramian PASSED IN (``gram_all``, cached per serving unit by
+    :class:`FoldInSolver`) instead of recomputed per dispatch, which is
+    what makes a 2-second apply cadence affordable on a large catalog.
+    Explicit feedback ignores ``gram_all`` (pass zeros)."""
+    if implicit_prefs:
+        p = jnp.where(row_val > 0, 1.0, 0.0)
+        if alpha_is_zero:
+            # c = 1 everywhere: the per-rating Gramian term vanishes
+            _, rhs, cnt = rows_gram_rhs(
+                factors, row_tgt, row_seg, row_val=p, row_w=row_w,
+                num_segments=num_segments, chunk_rows=chunk_rows)
+            A = jnp.broadcast_to(
+                gram_all, (num_segments,) + gram_all.shape)
+        else:
+            cm1 = alpha * jnp.abs(row_val)               # c - 1
+            vals = jnp.where(cm1 > 0,
+                             (1.0 + cm1) * p / jnp.maximum(cm1, 1e-12), 0.0)
+            gram, rhs, _ = rows_gram_rhs(
+                factors, row_tgt, row_seg,
+                row_val=vals, row_w=row_w * cm1,
+                num_segments=num_segments, chunk_rows=chunk_rows)
+            cnt = segment_count(row_seg, row_w.sum(axis=1), num_segments)
+            A = gram_all[None, :, :] + gram
+        lam = reg * jnp.where(weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
+        A = A + lam[:, None, None] * jnp.eye(factors.shape[1], dtype=A.dtype)
+        return batched_spd_solve(A, rhs)
+    gram, rhs, cnt = rows_gram_rhs(
+        factors, row_tgt, row_seg, row_val=row_val, row_w=row_w,
+        num_segments=num_segments, chunk_rows=chunk_rows)
+    lam = reg * jnp.where(weighted_reg, jnp.maximum(cnt, 1.0), 1.0)
+    A = gram + lam[:, None, None] * jnp.eye(factors.shape[1],
+                                            dtype=gram.dtype)
+    return batched_spd_solve(A, rhs)
+
+
+_GRAM_FN = jax.jit(lambda v: v.T @ v)
+
+
+class FoldInSolver:
+    """Device-batched online fold-in against one frozen factor matrix.
+
+    The composable unit iALS++ (arXiv:2110.14044) and ALX
+    (arXiv:2112.02194) both build on: with the opposite side's factors
+    frozen, each pending row (a user with fresh events, or an item with
+    fresh raters) is an independent K x K least-squares solve — so B
+    pending rows batch into ONE device program: gather each row's rated
+    columns from `factors` (the ALX padded-row layout, reusing the
+    training path's `_row_positions` packing + `rows_gram_rhs` Gramian
+    assembly), add the per-unit cached global Gramian (implicit
+    feedback's V^T V term, computed once per serving unit, not per
+    apply), and run one batched Cholesky.
+
+    Shapes are bucketed to powers of two (segment count AND packed row
+    count) and registered in the ``als_foldin`` fn_cache family, so a
+    server folding every few seconds compiles a bucket ladder once and
+    then never again — the compile ledger stays bounded however long the
+    event stream runs.
+    """
+
+    def __init__(self, factors: np.ndarray, params: ALSParams,
+                 row_len: int = 32, factors_device=None):
+        self.params = params
+        self.row_len = max(1, int(row_len))
+        host = np.ascontiguousarray(np.asarray(factors), np.float32)
+        self._shape = host.shape
+        #: resident device copy — callers with an already-resident array
+        #: (ALSModel.V_device) pass it to skip the upload
+        self._dev = (factors_device if factors_device is not None
+                     else jax.device_put(host))
+        self._gram = None        # lazy [K, K] V^T V (implicit) / zeros
+
+    @property
+    def rank(self) -> int:
+        return self._shape[1]
+
+    def _gram_dev(self):
+        if self._gram is None:
+            if self.params.implicit_prefs:
+                self._gram = _GRAM_FN(self._dev)
+            else:
+                self._gram = jnp.zeros((self.rank, self.rank), jnp.float32)
+        return self._gram
+
+    def solve(self, rated, values, weights=None) -> np.ndarray:
+        """Solve rows for B segments: ``rated[i]`` holds segment i's
+        rated opposite-side indices (int), ``values[i]`` the rating
+        values, optional ``weights[i]`` per-rating weights (default 1).
+        Returns host float32 [B, K]. A segment with zero ratings solves
+        to the zero row — callers should skip empties instead of
+        applying them."""
+        b = len(rated)
+        if b != len(values):
+            raise ValueError(f"rated/values length mismatch: {b} vs "
+                             f"{len(values)}")
+        if b == 0:
+            return np.zeros((0, self.rank), np.float32)
+        counts = np.fromiter((len(r) for r in rated), dtype=np.int64,
+                             count=b)
+        if weights is not None and [len(w) for w in weights] != \
+                counts.tolist():
+            raise ValueError("weights must parallel rated per segment")
+        seg = np.repeat(np.arange(b, dtype=np.int64), counts)
+        total = int(counts.sum())
+        if total:
+            tgt = np.concatenate([np.asarray(r) for r in rated]
+                                 ).astype(np.int32)
+            val = np.concatenate([np.asarray(v) for v in values]
+                                 ).astype(np.float32)
+            w = (np.concatenate([np.asarray(x) for x in weights]
+                                ).astype(np.float32)
+                 if weights is not None
+                 else np.ones(total, np.float32))
+            bad = (tgt < 0) | (tgt >= self._shape[0])
+            if bad.any():
+                raise ValueError(
+                    f"rated indices out of range [0, {self._shape[0]})")
+        else:
+            tgt = np.zeros(0, np.int32)
+            val = np.zeros(0, np.float32)
+            w = np.zeros(0, np.float32)
+        b_pad = bucket_size(b)
+        rrow, col, n_rows, row_seg = _row_positions(seg, self.row_len,
+                                                    b_pad)
+        r_pad = bucket_size(max(n_rows, 1))
+        row_tgt = np.zeros((r_pad, self.row_len), np.int32)
+        row_val = np.zeros((r_pad, self.row_len), np.float32)
+        row_w = np.zeros((r_pad, self.row_len), np.float32)
+        # pad rows aim at the LAST (padding) segment with weight 0, so
+        # row_seg stays sorted and the pads contribute nothing
+        seg_arr = np.full((r_pad,), b_pad - 1, np.int32)
+        seg_arr[:n_rows] = row_seg
+        if rrow is not None:
+            row_tgt[rrow, col] = tgt
+            row_val[rrow, col] = val
+            row_w[rrow, col] = w
+        p = self.params
+        key = (self._shape, b_pad, r_pad, self.row_len,
+               p.implicit_prefs, p.weighted_reg, p.alpha == 0)
+        # shape_cached_fn returns the SAME shared jit (executables live
+        # in jit's cache); its build counter is the per-bucket compile
+        # ledger pio_jax_compile_total{family=als_foldin} reads
+        fn = shape_cached_fn(FOLDIN_FAMILY, key, lambda: _foldin_solve)
+        out = fn(self._dev, self._gram_dev(), jnp.asarray(row_tgt),
+                 jnp.asarray(seg_arr), jnp.asarray(row_val),
+                 jnp.asarray(row_w), p.reg, p.alpha,
+                 num_segments=b_pad, implicit_prefs=p.implicit_prefs,
+                 weighted_reg=p.weighted_reg,
+                 alpha_is_zero=(p.alpha == 0), chunk_rows=1024)
+        return np.asarray(jax.device_get(out))[:b]

@@ -47,6 +47,7 @@ import datetime as _dt
 import functools
 import json
 import logging
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional
@@ -62,8 +63,8 @@ from predictionio_tpu.deploy.canary import (
 )
 from predictionio_tpu.deploy.releases import release_to_json, resolve_release
 from predictionio_tpu.deploy.warm import (
-    DeployError, ServingUnit, WarmupReport, build_unit, deploy_metrics,
-    verify_unit, warmup_unit,
+    DeployError, FoldinSwapRaced, ServingUnit, WarmupReport, build_unit,
+    deploy_metrics, verify_unit, warmup_unit,
 )
 from predictionio_tpu.obs.jax_stats import register_jax_metrics
 from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
@@ -73,7 +74,9 @@ from predictionio_tpu.ops.bucketing import bucket_size, padding_waste
 from predictionio_tpu.server.plugins import PluginContext
 from predictionio_tpu.storage.base import EngineInstance, Release, generate_id
 from predictionio_tpu.storage.registry import Storage
-from predictionio_tpu.utils.server_config import DeployConfig, ServingConfig
+from predictionio_tpu.utils.server_config import (
+    DeployConfig, FoldinConfig, ServingConfig,
+)
 
 logger = logging.getLogger("pio.queryserver")
 
@@ -356,7 +359,8 @@ class QueryServer:
                  registry: Optional[MetricsRegistry] = None,
                  serving_config: Optional[ServingConfig] = None,
                  deploy_config: Optional[DeployConfig] = None,
-                 release: Optional[Release] = None):
+                 release: Optional[Release] = None,
+                 foldin_config: Optional[FoldinConfig] = None):
         self.engine = engine
         self.feedback = feedback
         self.feedback_app_name = feedback_app_name
@@ -381,6 +385,10 @@ class QueryServer:
         register_jax_metrics(default_registry())
         self.serving_config = serving_config or ServingConfig.from_env()
         self.deploy_config = deploy_config or DeployConfig.from_env()
+        self.foldin_config = foldin_config or FoldinConfig.from_env()
+        #: online fold-in controller (deploy/foldin.py), started on the
+        #: server loop when enabled AND the engine supports it
+        self._foldin = None
         #: dedicated bounded pool for predictions ONLY — feedback writes
         #: and remote logging stay on the loop's default executor, so a
         #: burst of event-store writes can never starve the hot path (and
@@ -414,6 +422,12 @@ class QueryServer:
         self._attach_batcher(self._unit)
         self._standby: Optional[ServingUnit] = None
         self._canary: Optional["CanaryState"] = None
+        #: serializes unit-reference cutover against the fold-in
+        #: controller's executor-thread swaps (deploy/foldin.py): the
+        #: deploy paths assign on the event loop, fold-in compare-and-
+        #: swaps from the deploy executor — without the lock a reload
+        #: completing during a fold-in solve could be silently reverted
+        self._swap_lock = threading.Lock()
         #: strong refs to fire-and-forget deploy tasks (retire/verdict/
         #: shadow) — the loop holds tasks weakly, so an unreferenced one
         #: can be garbage-collected mid-flight
@@ -438,10 +452,34 @@ class QueryServer:
             labelnames=("status",))
         self.app = web.Application(middlewares=[
             observability_middleware(self.registry, "query_server")])
+        self.app.on_startup.append(self._on_startup_foldin)
         self.app.on_cleanup.append(self._on_cleanup)
         self._routes()
 
+    async def _on_startup_foldin(self, app) -> None:
+        """Start the online fold-in controller when enabled and the
+        deployed engine implements the fold-in hooks; an unsupported
+        engine logs and serves exactly as before."""
+        if not self.foldin_config.enabled:
+            return
+        from predictionio_tpu.deploy.foldin import (
+            FoldInController, FoldinUnsupported,
+        )
+
+        try:
+            self._foldin = FoldInController(self, self.foldin_config,
+                                            registry=self.registry)
+        except FoldinUnsupported as e:
+            logger.warning("online fold-in disabled: %s", e)
+            return
+        self._foldin.start()
+        logger.info("online fold-in armed: interval %.2fs, max pending %d",
+                    self.foldin_config.apply_interval_s,
+                    self.foldin_config.max_pending)
+
     async def _on_cleanup(self, app) -> None:
+        if self._foldin is not None:
+            await self._foldin.aclose()
         # settle the deploy background tasks first (a mid-drain
         # _retire_batcher would otherwise die as a destroyed-pending task)
         for task in list(self._bg_tasks):
@@ -889,9 +927,10 @@ class QueryServer:
         drains in the background. ``retire_old=False`` leaves the
         outgoing unit's release status to the caller (rollback marks it
         ROLLED_BACK, not RETIRED)."""
-        old = self._unit
         with self._phase_timer("swap"):
-            self._unit = unit
+            with self._swap_lock:
+                old = self._unit
+                self._unit = unit
         self._deploy.swap_total.inc(mode=mode, outcome="ok")
         self._deploy.active_version.set(float(unit.release_version))
         self._standby = old
@@ -903,6 +942,68 @@ class QueryServer:
                                      f"superseded: {reason}")
         logger.info("swapped to engine instance %s (%s: %s)",
                     unit.instance.id, mode, reason)
+
+    # -- online fold-in cutover (deploy/foldin.py) ---------------------------
+    def build_foldin_unit(self, new_models, applied_rows: int,
+                          drift_release: Optional[Release] = None,
+                          base_unit: Optional[ServingUnit] = None
+                          ) -> ServingUnit:
+        """A fold-in drift of the active unit: same instance/ctx, new
+        models, and `foldin_of` pinned to the PRE-fold-in base so every
+        later drift (and the rollback path) can find it."""
+        base = base_unit if base_unit is not None else self._unit
+        result = dataclasses.replace(base.result, models=list(new_models))
+        unit = ServingUnit(
+            instance=base.instance, result=result, ctx=base.ctx,
+            vectorized=self._compute_vectorized(result),
+            release=drift_release or base.release)
+        unit.foldin_of = base.foldin_of or base
+        unit.foldin_rows = base.foldin_rows + applied_rows
+        return unit
+
+    def swap_foldin_unit(self, unit: ServingUnit, loop=None,
+                         expected_base: Optional[ServingUnit] = None
+                         ) -> None:
+        """Fold-in cutover: the /reload atomic-swap discipline, warmup
+        only when the drift grew the catalog (the controller pre-warms
+        before calling; a user-only drift keeps the base's shapes). One
+        reference assignment; in-flight batches keep scoring the unit
+        they were routed to; the standby is pinned to the PRE-fold-in
+        base so `pio rollback` restores pre-fold-in answers. Callable
+        from any thread — the old batcher's drain is marshaled onto
+        `loop` when one is running.
+
+        ``expected_base`` makes it a compare-and-swap: the solve ran
+        against a snapshot of the serving unit, and a /reload, /deploy,
+        rollback, or canary cutover that landed meanwhile must win —
+        raises :class:`FoldinSwapRaced` (the controller requeues its
+        deltas) instead of silently reverting a real deploy to a drift
+        of the old model."""
+        if unit.batcher is None:
+            self._attach_batcher(unit)
+        with self._phase_timer("swap"):
+            with self._swap_lock:
+                if expected_base is not None and \
+                        self._unit is not expected_base:
+                    self._deploy.swap_total.inc(mode="foldin",
+                                                outcome="raced")
+                    raise FoldinSwapRaced(
+                        "serving unit changed during the fold-in solve "
+                        f"(now instance {self._unit.instance.id})")
+                if self._canary is not None:
+                    self._deploy.swap_total.inc(mode="foldin",
+                                                outcome="raced")
+                    raise FoldinSwapRaced(
+                        "canary window opened during the fold-in solve")
+                old = self._unit
+                self._unit = unit
+        self._deploy.swap_total.inc(mode="foldin", outcome="ok")
+        self._deploy.active_version.set(float(unit.release_version))
+        self._standby = unit.foldin_of
+        if loop is not None and loop.is_running():
+            fut = asyncio.run_coroutine_threadsafe(
+                self._retire_batcher(old), loop)
+            fut.add_done_callback(_log_retire_failure)
 
     async def _retire_batcher(self, unit: ServingUnit,
                               timeout: Optional[float] = None) -> None:
@@ -1258,6 +1359,9 @@ class QueryServer:
             } if canary is not None else None),
             "lastWarmup": (self._last_warmup.to_dict()
                            if self._last_warmup else None),
+            "foldin": (self._foldin.status_dict()
+                       if self._foldin is not None
+                       else {"enabled": False}),
         })
 
     async def handle_stop(self, request):
@@ -1273,6 +1377,15 @@ class QueryServer:
 
 def _raise_shutdown():
     raise web.GracefulExit()
+
+
+def _log_retire_failure(fut) -> None:
+    """Done-callback for the fold-in swap's cross-thread batcher drain:
+    surface failures instead of letting the future swallow them."""
+    try:
+        fut.result()
+    except Exception:
+        logger.exception("fold-in batcher retirement failed")
 
 
 def create_query_server(engine: Engine, train_result: TrainResult,
@@ -1295,6 +1408,9 @@ def run_query_server(engine: Engine, train_result: TrainResult,
     kwargs.setdefault("serving_config", cfg.serving)
     # warm-swap/canary tuning from server.json "deploy" + PIO_CANARY_* env
     kwargs.setdefault("deploy_config", cfg.deploy)
+    # online fold-in knobs from server.json "foldin" + PIO_FOLDIN_* env
+    # (pio deploy passes an engine.json-aware config explicitly)
+    kwargs.setdefault("foldin_config", cfg.foldin)
     server = create_query_server(engine, train_result, instance, ctx, **kwargs)
     ssl_ctx = cfg.ssl_context()
     logger.info("Query server listening on %s:%s%s", ip, port,

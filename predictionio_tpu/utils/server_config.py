@@ -20,6 +20,8 @@ Config file: ``$PIO_CONF_DIR/server.json`` (or the path in
      "ingest": {"maxEventsPerBatch": 50, "buffer": true, "queueMax": 8192,
                 "flushMax": 256, "lingerS": 0.002, "retries": 4},
      "train": {"alsSolver": "subspace", "alsBlockSize": 16},
+     "foldin": {"enabled": false, "applyIntervalS": 2.0,
+                "maxPending": 1024},
      "batchpredict": {"chunkSize": 1024, "queueChunks": 4,
                       "pipelined": true, "outputFormat": "jsonl"}}
 
@@ -234,6 +236,91 @@ class TrainConfig:
         if cfg.als_block_size is not None:
             cfg.als_block_size = max(1, cfg.als_block_size)
         return cfg
+
+
+@dataclasses.dataclass
+class FoldinConfig:
+    """Online fold-in tuning (the ``PIO_FOLDIN_*`` knobs; server.json
+    ``foldin`` section, camelCase keys; an engine.json top-level
+    ``foldin`` section overrides the host file, env overrides both —
+    the established precedence).
+
+    ``enabled=True`` starts the query server's fold-in controller
+    (deploy/foldin.py) when the deployed engine supports it: fresh
+    events are turned into updated factor rows between full retrains —
+    solved on device in one batched program per apply — and swapped into
+    the live ServingUnit with the /reload atomic-swap discipline.
+    ``apply_interval_s`` is the apply cadence (the freshness bound:
+    p95 event→reflected ≈ interval + one batched solve);
+    ``max_pending`` caps the rows one apply folds (excess stays pending
+    for the next tick — backpressure, not loss); an apply also fires
+    early once ``max_pending`` rows are waiting. ``row_len`` is the
+    static packed-row width of the batched solver (ratings per device
+    row; heavy entities span several rows).
+    """
+
+    enabled: bool = False
+    apply_interval_s: float = 2.0
+    max_pending: int = 1024
+    row_len: int = 32
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None,
+                 variant: Optional[dict] = None) -> "FoldinConfig":
+        """Per-knob precedence, weakest first: server.json ``foldin``
+        section (``data``) < engine.json ``foldin`` section
+        (``variant``) < ``PIO_FOLDIN_*`` env. Malformed knobs are logged
+        and fall back, same contract as ServingConfig."""
+        data = data or {}
+        variant = variant or {}
+        cfg = cls()
+        as_bool = lambda v: str(v).strip().lower() not in (  # noqa: E731
+            "0", "false", "no", "off", "")
+        sources = (
+            ("enabled", data.get("enabled"), "enabled", as_bool),
+            ("applyIntervalS", data.get("applyIntervalS"),
+             "apply_interval_s", float),
+            ("maxPending", data.get("maxPending"), "max_pending", int),
+            ("rowLen", data.get("rowLen"), "row_len", int),
+            ("engine.json enabled", variant.get("enabled"),
+             "enabled", as_bool),
+            ("engine.json applyIntervalS", variant.get("applyIntervalS"),
+             "apply_interval_s", float),
+            ("engine.json maxPending", variant.get("maxPending"),
+             "max_pending", int),
+            ("engine.json rowLen", variant.get("rowLen"), "row_len", int),
+            ("PIO_FOLDIN", os.environ.get("PIO_FOLDIN"),
+             "enabled", as_bool),
+            ("PIO_FOLDIN_APPLY_INTERVAL_S",
+             os.environ.get("PIO_FOLDIN_APPLY_INTERVAL_S"),
+             "apply_interval_s", float),
+            ("PIO_FOLDIN_MAX_PENDING",
+             os.environ.get("PIO_FOLDIN_MAX_PENDING"),
+             "max_pending", int),
+            ("PIO_FOLDIN_ROW_LEN", os.environ.get("PIO_FOLDIN_ROW_LEN"),
+             "row_len", int),
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed foldin knob %s=%r",
+                               name, raw)
+        cfg.apply_interval_s = max(0.01, cfg.apply_interval_s)
+        cfg.max_pending = max(1, cfg.max_pending)
+        cfg.row_len = max(1, cfg.row_len)
+        return cfg
+
+
+def foldin_config(variant_section: Optional[dict] = None) -> FoldinConfig:
+    """Resolve the fold-in knobs a query server should run with:
+    ``variant_section`` is the engine.json top-level ``foldin`` section,
+    which overrides the host-level server.json section; the
+    ``PIO_FOLDIN_*`` env vars override both."""
+    data = read_server_json().get("foldin") or {}
+    return FoldinConfig.from_env(data, variant_section)
 
 
 @dataclasses.dataclass
@@ -510,6 +597,7 @@ class ServerConfig:
     deploy: DeployConfig = dataclasses.field(default_factory=DeployConfig)
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    foldin: FoldinConfig = dataclasses.field(default_factory=FoldinConfig)
     batchpredict: BatchPredictConfig = dataclasses.field(
         default_factory=BatchPredictConfig)
 
@@ -527,6 +615,7 @@ class ServerConfig:
             deploy=DeployConfig.from_env(data.get("deploy") or {}),
             ingest=IngestConfig.from_env(data.get("ingest") or {}),
             train=TrainConfig.from_env(data.get("train") or {}),
+            foldin=FoldinConfig.from_env(data.get("foldin") or {}),
             batchpredict=BatchPredictConfig.from_env(
                 data.get("batchpredict") or {}),
         )

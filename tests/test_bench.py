@@ -209,6 +209,46 @@ def test_bench_ingest_write_smoke(tmp_path):
     assert detail["speedup_headline"] >= 1.5
 
 
+def test_bench_foldin_freshness_smoke(tmp_path):
+    """Smoke the foldin_freshness config at a shrunken scale: the config
+    itself asserts the batched-solve speedup floor, the bounded
+    als_foldin compile ledger, and the p95 event→reflected bound; the
+    emitted detail must carry the freshness + throughput fields the
+    judged run records. The judged-scale speedup floor is 5x (the
+    tentpole bar); the smoke floor is relaxed and the p95 slack widened
+    — a busy 2-core CI box pays scheduler noise per apply tick."""
+    p = _run("foldin_freshness", "300", timeout=280, tmp_path=tmp_path,
+             extra_env={"BENCH_FOLDIN_USERS": "300",
+                        "BENCH_FOLDIN_ITEMS": "150",
+                        "BENCH_FOLDIN_RANK": "8",
+                        "BENCH_FOLDIN_SOLVE_BATCH": "32",
+                        "BENCH_FOLDIN_STREAM_USERS": "12",
+                        "BENCH_FOLDIN_MIN_SPEEDUP": "1.5",
+                        "BENCH_FOLDIN_P95_SLACK": "5.0"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert "foldin_freshness" in out["unit"]
+    detail = next(d for d in
+                  json.load(open(tmp_path / "details.json"))["details"]
+                  if d["name"] == "foldin_freshness")
+    for key in ("foldins_per_s_batched", "foldins_per_s_sequential",
+                "speedup_batched", "foldin_compiled_shapes",
+                "foldin_shape_bound", "p50_event_to_reflected_s",
+                "p95_event_to_reflected_s", "p95_bound_s", "applies",
+                "applied_user_rows"):
+        assert key in detail, (key, detail)
+    # the tentpole contract, visible in the judged artifact: one
+    # batched device program beats per-row dispatches and the solver's
+    # compiled shapes stay inside the bucket ladder
+    assert detail["speedup_batched"] >= 1.5
+    assert 0 < detail["foldin_compiled_shapes"] \
+        <= detail["foldin_shape_bound"]
+    assert detail["p95_event_to_reflected_s"] <= detail["p95_bound_s"]
+    assert detail["applied_user_rows"] >= 12
+
+
 def test_bench_als_kernel_smoke(tmp_path):
     """Smoke the als_kernel config at a shrunken scale: the config itself
     asserts held-out RMSE parity at matched quality and the als_train

@@ -1074,11 +1074,12 @@ def cfg_serving_batching(jax, mesh, platform):
         await asyncio.gather(*[client(k, per_client)
                                for k in range(n_clients)])
 
-    def sweep(serving_config, levels, tag):
+    def sweep(serving_config, levels, tag, slo_spec=None):
         # one server + one HTTP client span the whole sweep: app cleanup
         # shuts the server's predict executor, so apps are single-use
         server = create_query_server(engine, result, instance, None,
-                                     serving_config=serving_config)
+                                     serving_config=serving_config,
+                                     slo_spec=slo_spec)
         size_hist = server.registry.get("pio_batch_size")
         out = {}
 
@@ -1134,6 +1135,63 @@ def cfg_serving_batching(jax, mesh, platform):
                                      batch_linger_s=0.0,
                                      batch_inflight=1),
                        clients[-1:], "single-inflight")
+
+        # observability overhead: tracing + flight recording + a live SLO
+        # burn-rate engine (evaluating every 50ms) vs the obs-off state
+        # (PIO_TRACING=0, no SLO engine — metrics stay on either way).
+        # Alternating best-of-N p99 at the top client level; the plane
+        # must cost within BENCH_OBS_OVERHEAD_PCT (default 5%) of the
+        # obs-off p99 (+ a small absolute slack absorbing sub-ms noise).
+        from predictionio_tpu.obs.slo import SLOEngine  # noqa: F401
+        from predictionio_tpu.obs.slo import SLOObjective, SLOSpec, SLOWindow
+
+        hb("serving_batching obs-overhead")
+        obs_spec = SLOSpec(
+            objectives=[
+                SLOObjective("latency", "latency", threshold_s=0.256,
+                             budget=0.01),
+                SLOObjective("errors", "errors", budget=0.01)],
+            # burn threshold astronomically high: the engine does its
+            # full evaluation work but never flips (the flip path is
+            # tested elsewhere; here we charge only its steady cost)
+            windows=[SLOWindow(2.0, 1e12)],
+            eval_interval_s=0.05)
+        obs_cfg = lambda: ServingConfig(  # noqa: E731
+            batch_max=max_batch, batch_linger_s=None, batch_inflight=2)
+        repeats = int(os.environ.get("BENCH_OBS_REPEATS", 3))
+        old_tracing = os.environ.get("PIO_TRACING")
+        on_p99, off_p99 = [], []
+        # measure at the MID concurrency level: the top level runs queue-
+        # saturated, where p99 is scheduling noise (3x run-to-run swings
+        # on the same config) — a per-request overhead comparison needs
+        # the stable regime. Alternating best-of-N bounds the tail noise.
+        obs_level = [clients[1] if len(clients) > 1 else clients[-1]]
+        try:
+            for _ in range(repeats):
+                os.environ["PIO_TRACING"] = "0"
+                off_p99.append(
+                    sweep(obs_cfg(), obs_level, "obs-off")
+                    [obs_level[0]]["p99_ms"])
+                os.environ["PIO_TRACING"] = "1"
+                on_p99.append(
+                    sweep(obs_cfg(), obs_level, "obs-on",
+                          slo_spec=obs_spec)[obs_level[0]]["p99_ms"])
+        finally:
+            if old_tracing is None:
+                os.environ.pop("PIO_TRACING", None)
+            else:
+                os.environ["PIO_TRACING"] = old_tracing
+        obs_on_ms, obs_off_ms = min(on_p99), min(off_p99)
+        overhead_pct = (100.0 * (obs_on_ms - obs_off_ms) / obs_off_ms
+                        if obs_off_ms > 0 else 0.0)
+        max_pct = float(os.environ.get("BENCH_OBS_OVERHEAD_PCT", 5.0))
+        abs_slack_ms = float(os.environ.get(
+            "BENCH_OBS_OVERHEAD_ABS_MS", 0.3))
+        assert obs_on_ms <= obs_off_ms * (1 + max_pct / 100.0) \
+            + abs_slack_ms, (
+            f"observability overhead breached: p99 {obs_on_ms}ms with "
+            f"tracing+SLO vs {obs_off_ms}ms obs-off "
+            f"(+{overhead_pct:.1f}% > {max_pct}% + {abs_slack_ms}ms)")
     finally:
         als_mod._DEVICE_ROUNDTRIP_S = old_rt
 
@@ -1166,6 +1224,13 @@ def cfg_serving_batching(jax, mesh, platform):
             detail[f"{key}_{n_clients}c"] = val
     detail[f"p99_ms_{top}c_single_inflight"] = single[top]["p99_ms"]
     detail[f"mean_batch_{top}c_single_inflight"] = single[top]["mean_batch"]
+    obs_c = obs_level[0]
+    detail[f"p99_ms_{obs_c}c_obs_on"] = obs_on_ms
+    detail[f"p99_ms_{obs_c}c_obs_off"] = obs_off_ms
+    detail["obs_overhead_pct"] = round(overhead_pct, 2)
+    detail["note"] += (f"; obs overhead {overhead_pct:+.1f}% at {obs_c}c "
+                       f"(tracing+SLO p99 {obs_on_ms}ms vs obs-off "
+                       f"{obs_off_ms}ms)")
     return detail
 
 
@@ -2572,12 +2637,70 @@ class Suite:
                            "baselines": self.baselines}, f, indent=1)
         except OSError:
             pass
+        # perf trajectory: append every judged config run to its own
+        # BENCH_<config>.json history file (timestamped entries, headline
+        # numbers, environment fingerprint) — the record nine PRs of
+        # bench work never kept. History lands next to BENCH_DETAILS_PATH
+        # when overridden (tests write to tmp, not the repo).
+        history_dir = os.path.dirname(path)
+        for detail in self.details:
+            try:
+                append_bench_history(history_dir, detail,
+                                     partial=self.partial)
+            except OSError:
+                pass
         print(json.dumps({
             "metric": "judged_suite_wallclock",
             "value": round(total, 3),
             "unit": unit,
             "vs_baseline": round(geomean, 2),
         }), flush=True)
+
+
+def environment_fingerprint() -> dict:
+    """Enough context to interpret a historical bench number: interpreter,
+    machine shape, and every BENCH_* knob that shaped the run."""
+    import platform as _platform
+
+    return {
+        "python": sys.version.split()[0],
+        "machine": _platform.machine(),
+        "system": _platform.system(),
+        "cpus": os.cpu_count(),
+        "bench_env": {k: v for k, v in sorted(os.environ.items())
+                      if k.startswith("BENCH_")},
+    }
+
+
+def append_bench_history(history_dir: str, detail: dict,
+                         partial: bool = False) -> str:
+    """Append one judged run to BENCH_<config>.json (a JSON list; read,
+    append, temp-write + atomic rename). Returns the history path."""
+    import datetime as _dt
+
+    name = detail.get("name", "unknown")
+    path = os.path.join(history_dir, f"BENCH_{name}.json")
+    history = []
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                history = json.load(f)
+            if not isinstance(history, list):
+                history = []
+        except (OSError, ValueError):
+            history = []
+    history.append({
+        "ts": _dt.datetime.now(_dt.timezone.utc).isoformat(
+            timespec="seconds"),
+        "partial": partial,
+        "detail": {k: v for k, v in detail.items() if k != "name"},
+        "env": environment_fingerprint(),
+    })
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(history, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
 
 
 def orchestrate(names, partial=False):

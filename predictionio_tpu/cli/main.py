@@ -31,8 +31,16 @@ def version():
 
 
 @cli.command()
-def status():
-    """Verify storage configuration (Console.scala:435, Management.scala:99)."""
+@click.option("--fleet", "fleet_path", default=None,
+              help="Show the merged fleet observability of a sharded run: "
+                   "the <output>.fleet.json a batchpredict merge commits "
+                   "(or the output path itself).")
+def status(fleet_path):
+    """Verify storage configuration (Console.scala:435, Management.scala:99);
+    with --fleet, print a sharded run's merged per-process metric view."""
+    if fleet_path:
+        _print_fleet(fleet_path)
+        return
     from predictionio_tpu.storage import Storage
     click.echo("[INFO] Inspecting predictionio_tpu installation...")
     click.echo(f"[INFO] Version {__version__}")
@@ -43,6 +51,48 @@ def status():
         sys.exit(1)
     click.echo("[INFO] All storage backends are properly configured.")
     click.echo("[INFO] Your system is all ready to go.")
+
+
+def _print_fleet(path):
+    """The merged fleet view: per-process counters, exact fleet totals,
+    and the trace ids spanning the run."""
+    import os
+
+    if not path.endswith(".fleet.json") and not os.path.exists(path):
+        path = f"{path}.fleet.json"
+    elif os.path.isfile(f"{path}.fleet.json"):
+        path = f"{path}.fleet.json"
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        click.echo(f"[ERROR] cannot read fleet view {path}: {e}")
+        sys.exit(1)
+    click.echo(f"[INFO] Fleet view {path}: "
+               f"{len(doc.get('processes', []))} process(es) "
+               f"{doc.get('processes')}")
+    totals = doc.get("counterTotals", {})
+    metrics = doc.get("metrics", {})
+    for name in sorted(totals):
+        click.echo(f"[INFO] {name} fleet total: {totals[name]:g}")
+        for sample in metrics.get(name, {}).get("samples", []):
+            labels = sample.get("labels", {})
+            proc = labels.get("process", "?")
+            rest = {k: v for k, v in labels.items() if k != "process"}
+            suffix = f" {rest}" if rest else ""
+            click.echo(f"[INFO]   process {proc}{suffix}: "
+                       f"{sample.get('value'):g}")
+    trace_ids = []
+    for t in doc.get("traces", []):
+        tid = t.get("traceId")
+        if tid and tid not in trace_ids:
+            trace_ids.append(tid)
+    for tid in trace_ids:
+        spans = [t for t in doc.get("traces", [])
+                 if t.get("traceId") == tid]
+        procs = sorted({t.get("process", "?") for t in spans})
+        click.echo(f"[INFO] trace {tid}: {len(spans)} span(s) across "
+                   f"processes {procs}")
 
 
 # ---------------------------------------------------------------------------
@@ -523,6 +573,136 @@ def rollback(ip, port, accesskey):
 @cli.command()
 @click.option("--ip", default="localhost")
 @click.option("--port", default=8000, type=int)
+@click.option("--trace-id", "trace_id", default=None,
+              help="Only spans of this trace id.")
+@click.option("--limit", type=int, default=20,
+              help="Most recent N trace records (default 20).")
+@click.option("--events", "show_events", is_flag=True,
+              help="Also print lifecycle events (deploys, swaps, "
+                   "fold-in applies, canary verdicts, SLO breaches).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw /debug/traces.json body.")
+def traces(ip, port, trace_id, limit, show_events, as_json):
+    """Read a live server's flight recorder (GET /debug/traces.json):
+    the bounded ring of recent traces + lifecycle events. Works against
+    any server in the fleet (event server, query server, admin,
+    dashboard)."""
+    import urllib.parse
+    import urllib.request
+
+    params = {"limit": str(limit)}
+    if trace_id:
+        params["traceId"] = trace_id
+    url = (f"http://{ip}:{port}/debug/traces.json?"
+           + urllib.parse.urlencode(params))
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.loads(r.read().decode())
+    except Exception as e:
+        click.echo(f"[ERROR] Unable to read {url}: {e}")
+        sys.exit(1)
+    if as_json:
+        click.echo(json.dumps(doc, indent=1, sort_keys=True))
+        return
+    for t in doc.get("traces", []):
+        spans = " ".join(f"{k}={v * 1e3:.1f}ms"
+                         for k, v in (t.get("spans") or {}).items())
+        click.echo(f"[INFO] {t.get('traceId', '?')[:12]} "
+                   f"{t.get('name')} {t.get('durationSec', 0) * 1e3:.1f}ms "
+                   f"[{t.get('status')}] proc={t.get('process')}"
+                   + (f" | {spans}" if spans else ""))
+    if show_events:
+        for e in doc.get("events", []):
+            tid = (e.get("traceId") or "-")[:12]
+            rest = {k: v for k, v in e.items()
+                    if k not in ("kind", "ts", "traceId", "process")}
+            click.echo(f"[INFO] event {e.get('kind')} trace={tid} {rest}")
+    click.echo(f"[INFO] {len(doc.get('traces', []))} trace record(s), "
+               f"{len(doc.get('events', []))} lifecycle event(s).")
+
+
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=8000, type=int)
+@click.option("--accesskey", default=None)
+@click.option("--seconds", type=float, default=2.0,
+              help="Capture window (capped server-side at 60s).")
+@click.option("--dir", "outdir", default=None,
+              help="Trace output directory (server-side path; default a "
+                   "fresh temp dir).")
+def profile(ip, port, accesskey, seconds, outdir):
+    """Capture a bounded on-demand device profile from a live query
+    server (POST /debug/profile): a jax.profiler trace plus the
+    per-compile-family dispatch-time attribution table."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{ip}:{port}/debug/profile"
+    if accesskey:
+        url += f"?accessKey={accesskey}"
+    body = json.dumps({"seconds": seconds,
+                       **({"dir": outdir} if outdir else {})}).encode()
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=seconds + 30) as r:
+            out = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            message = json.loads(e.read().decode()).get("message", str(e))
+        except Exception:
+            message = str(e)
+        click.echo(f"[ERROR] Profile failed: {message}")
+        sys.exit(1)
+    except Exception as e:
+        click.echo(f"[ERROR] Unable to reach query server: {e}")
+        sys.exit(1)
+    click.echo(f"[INFO] Captured {out.get('seconds')}s device profile "
+               f"-> {out.get('traceDir')}")
+    dispatch = out.get("dispatch") or {}
+    if dispatch:
+        click.echo("[INFO] Device seconds by compile family "
+                   "(cumulative since process start):")
+        for family, secs in dispatch.items():
+            click.echo(f"[INFO]   {family:<24} {secs:.3f}s")
+    else:
+        click.echo("[INFO] No dispatch attribution recorded yet "
+                   "(PIO_DISPATCH_ATTRIBUTION=0, or nothing dispatched).")
+
+
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=8000, type=int)
+def slo(ip, port):
+    """Read a live query server's SLO burn-rate evaluation (/slo.json)."""
+    import urllib.request
+
+    url = f"http://{ip}:{port}/slo.json"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.loads(r.read().decode())
+    except Exception as e:
+        click.echo(f"[ERROR] Unable to read {url}: {e}")
+        sys.exit(1)
+    if not doc.get("enabled"):
+        click.echo("[INFO] SLO engine disabled "
+                   '(configure server.json {"slo": {...}}).')
+        return
+    state = "BREACHED" if doc.get("breached") else "ok"
+    click.echo(f"[INFO] SLO status: {state}")
+    for obj in doc.get("objectives", []):
+        mark = "BREACHED" if obj.get("breached") else "ok"
+        windows = ", ".join(
+            f"{int(w['seconds'])}s burn {w['burn']:.2f}/{w['burnThreshold']}"
+            for w in obj.get("windows", []))
+        click.echo(f"[INFO]   {obj['name']} ({obj['kind']}): {mark} "
+                   f"[{windows}]")
+
+
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=8000, type=int)
 @click.option("--accesskey", default=None)
 def undeploy(ip, port, accesskey):
     """Stop a deployed query server (Console.scala:318)."""
@@ -711,6 +891,16 @@ def batchpredict(variant, input_path, output_path, engine_instance_id,
     if report.merged:
         click.echo(f"[INFO] Wrote {report.total_written} predictions to "
                    f"{report.output_path}")
+        if report.fleet:
+            totals = report.fleet.get("counterTotals", {})
+            scored = totals.get("pio_batchpredict_queries_total")
+            click.echo(
+                f"[INFO] Fleet view ({len(report.fleet.get('processes', []))}"
+                f" process(es)) -> {report.output_path}.fleet.json"
+                + (f"; fleet queries scored {scored:g}"
+                   if scored is not None else "")
+                + "; inspect with `pio status --fleet "
+                + f"{report.output_path}`")
     else:
         rank, size = report.worker
         click.echo(f"[INFO] Shard {rank}/{size} wrote {report.written} "
@@ -720,6 +910,10 @@ def batchpredict(variant, input_path, output_path, engine_instance_id,
         n_bad = report.total_invalid if report.merged else report.invalid
         click.echo(f"[WARN] Skipped {n_bad} invalid queries "
                    f"-> {report.errors_path}")
+    if report.trace_id:
+        click.echo(f"[INFO] Trace id {report.trace_id} "
+                   "(follow with `pio traces --trace-id ...` on a live "
+                   "server, or in the .fleet.json)")
 
 
 # ---------------------------------------------------------------------------

@@ -46,6 +46,7 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.obs.tracing import capture_context, carried, span
 from predictionio_tpu.storage.base import StorageError, generate_id
 
 logger = logging.getLogger("pio.writebuffer")
@@ -121,15 +122,21 @@ def _with_id(e: Event) -> Event:
 
 
 class _Pending:
-    """One submit: its (already id-assigned) events and the caller future."""
+    """One submit: its (already id-assigned) events and the caller future.
 
-    __slots__ = ("events", "app_id", "channel_id", "future")
+    ``trace`` is the submitting request's captured trace context — the
+    writer thread re-enters it around the flush so the group-commit span
+    is linked to the request that triggered it instead of starting a
+    fresh, unattributable trace (the thread boundary used to drop it)."""
 
-    def __init__(self, events, app_id, channel_id, future):
+    __slots__ = ("events", "app_id", "channel_id", "future", "trace")
+
+    def __init__(self, events, app_id, channel_id, future, trace=None):
         self.events = events
         self.app_id = app_id
         self.channel_id = channel_id
         self.future = future
+        self.trace = trace
 
 
 def _start_attempt(fn, args) -> "concurrent.futures.Future":
@@ -182,6 +189,7 @@ class WriteBuffer:
 
         self._shed_total = self._retry_total = None
         self._flush_size = self._flush_duration = None
+        self._registry = registry
         if registry is not None:
             registry.gauge_callback(
                 "pio_ingest_queue_depth",
@@ -227,7 +235,8 @@ class WriteBuffer:
                 if self._shed_total is not None:
                     self._shed_total.inc(len(events))
                 raise BufferFull(self._depth, self._retry_after(self._depth))
-            self._queue.append(_Pending(events, app_id, channel_id, future))
+            self._queue.append(_Pending(events, app_id, channel_id, future,
+                                        trace=capture_context()))
             self._depth += len(events)
             if self._thread is None:
                 self._thread = threading.Thread(
@@ -279,7 +288,8 @@ class WriteBuffer:
         for (app_id, channel_id), pendings in groups.items():
             events = [e for p in pendings for e in p.events]
             try:
-                ids = self._flush_group(events, app_id, channel_id)
+                ids = self._flush_traced(events, app_id, channel_id,
+                                         pendings)
             except Exception as e:  # noqa: BLE001 — fanned out to callers
                 for p in pendings:
                     if not p.future.set_running_or_notify_cancel():
@@ -302,6 +312,23 @@ class WriteBuffer:
         self._last_flush_s = max(0.001, time.monotonic() - t0)
         if self._flush_duration is not None:
             self._flush_duration.observe(time.monotonic() - t0)
+
+    def _flush_traced(self, events, app_id, channel_id,
+                      pendings: List[_Pending]) -> List[str]:
+        """One group flush carried under the FIRST submitter's trace
+        context (when any submitter had one): the writer-thread span is
+        linked to the request that opened the batch — the coalesced
+        siblings ride the same flush and are represented by the batch
+        size attr — instead of the pre-PR behavior of an unattributed
+        thread-local span."""
+        ctx = next((p.trace for p in pendings if p.trace is not None), None)
+        if ctx is None:
+            return self._flush_group(events, app_id, channel_id)
+        with carried(ctx, "ingest_flush", registry=self._registry,
+                     attrs={"events": len(events),
+                            "submits": len(pendings)}):
+            with span("ingest_flush"):
+                return self._flush_group(events, app_id, channel_id)
 
     def _flush_group(self, events, app_id, channel_id) -> List[str]:
         """insert_batch with bounded retries; attempts after the first go

@@ -25,9 +25,17 @@ poisoning the whole canary.
 from __future__ import annotations
 
 import dataclasses
-import math
-from collections import deque
-from typing import Deque, Optional, Tuple
+from typing import Optional, Tuple
+
+#: the sliding-window stats + judgment now live in obs/slo.py (the
+#: reusable SLO substrate canary, fold-in gating and the burn-rate
+#: engine all consume); re-exported here so existing callers/tests keep
+#: their import path
+from predictionio_tpu.obs.slo import SlidingStats, judge_relative
+
+__all__ = ["CanaryConfig", "CanaryController", "SlidingStats",
+           "TrafficSplitter", "ROLE_INCUMBENT", "ROLE_CANARY",
+           "ROLE_SHADOW"]
 
 #: serving roles a query can be scored under
 ROLE_INCUMBENT = "incumbent"
@@ -83,48 +91,6 @@ class TrafficSplitter:
         return False
 
 
-class SlidingStats:
-    """Bounded latency/error window for one serving arm."""
-
-    def __init__(self, window: int):
-        self._lat: Deque[float] = deque(maxlen=max(1, window))
-        self._err: Deque[bool] = deque(maxlen=max(1, window))
-        self.total = 0
-
-    def observe(self, seconds: float, ok: bool) -> None:
-        self.total += 1
-        self._err.append(not ok)
-        if ok:
-            # failed queries have no meaningful serving latency; they
-            # count against the error SLO instead
-            self._lat.append(seconds)
-
-    def count(self) -> int:
-        return len(self._err)
-
-    def error_rate(self) -> float:
-        if not self._err:
-            return 0.0
-        return sum(self._err) / len(self._err)
-
-    def p99(self) -> float:
-        return self.quantile(0.99)
-
-    def quantile(self, q: float) -> float:
-        if not self._lat:
-            return 0.0
-        ordered = sorted(self._lat)
-        rank = min(len(ordered) - 1,
-                   max(0, math.ceil(q * len(ordered)) - 1))
-        return ordered[rank]
-
-    def to_dict(self) -> dict:
-        return {"samples": self.count(), "total": self.total,
-                "errorRate": round(self.error_rate(), 4),
-                "p50Sec": round(self.quantile(0.50), 6),
-                "p99Sec": round(self.p99(), 6)}
-
-
 class CanaryController:
     """The SLO judge for one candidate release.
 
@@ -157,24 +123,17 @@ class CanaryController:
         return verdict
 
     def _judge(self) -> Optional[Tuple[str, str]]:
+        """Delegates to the shared SLO judgment (obs/slo.py) — verdicts
+        are byte-identical to the pre-refactor inline logic, locked by
+        the canary test scenarios."""
         cfg = self.config
-        inc, can = self.incumbent, self.canary
-        if can.count() < cfg.min_samples or inc.count() < cfg.min_samples:
-            return None
-        can_err, inc_err = can.error_rate(), inc.error_rate()
-        if can_err > inc_err + cfg.error_rate_slack:
-            return ("rollback",
-                    f"slo_errors: canary {can_err:.3f} > incumbent "
-                    f"{inc_err:.3f} + {cfg.error_rate_slack}")
-        can_p99, inc_p99 = can.p99(), inc.p99()
-        if can_p99 > inc_p99 * cfg.p99_ratio + cfg.latency_slack_s:
-            return ("rollback",
-                    f"slo_latency: canary p99 {can_p99 * 1e3:.1f}ms > "
-                    f"incumbent p99 {inc_p99 * 1e3:.1f}ms x {cfg.p99_ratio} "
-                    f"+ {cfg.latency_slack_s * 1e3:.0f}ms")
-        if can.total >= cfg.promote_after:
-            return ("promote", "healthy: SLO window clean")
-        return None
+        return judge_relative(
+            self.incumbent, self.canary,
+            min_samples=cfg.min_samples,
+            error_rate_slack=cfg.error_rate_slack,
+            p99_ratio=cfg.p99_ratio,
+            latency_slack_s=cfg.latency_slack_s,
+            promote_after=cfg.promote_after)
 
     def to_dict(self) -> dict:
         return {

@@ -289,6 +289,11 @@ class FoldInController:
         self.applied_items = 0
         self.applies = 0
         self.last_apply_s: Optional[float] = None
+        #: the most recent tap's captured trace context: the next apply
+        #: re-enters it, so one trace id stitches ingest request ->
+        #: group-commit flush -> fold-in apply -> swap
+        self._last_trace = None
+        self._registry = registry
 
         reg = registry
         self._m_pending = foldin_pending(reg)
@@ -334,6 +339,14 @@ class FoldInController:
         entity types are ignored."""
         now = time.monotonic()
         kick = False
+        # the tap runs on the writer thread INSIDE the flush's carried
+        # trace — capture it so the apply that folds these events stays
+        # on the same trace id (None when tracing is off)
+        from predictionio_tpu.obs.tracing import capture_context
+
+        ctx = capture_context()
+        if ctx is not None:
+            self._last_trace = ctx
         with self._lock:
             for e in events:
                 eid = e.event_id
@@ -546,6 +559,16 @@ class FoldInController:
             # folding the incumbent mid-window would poison the judge's
             # baseline — deltas stay pending until the verdict lands
             return None
+        slo = getattr(self.server, "_slo", None)
+        if slo is not None and slo.breached(exclude_kinds=("freshness",)):
+            # SLO gating (obs/slo.py): while the serving latency/error
+            # SLO burns, a swap could make things worse — deltas stay
+            # pending (not lost) until the burn clears. Freshness
+            # breaches are EXCLUDED: deferring the apply is exactly what
+            # would deepen a freshness breach.
+            self._m_applies.inc(outcome="deferred")
+            logger.warning("fold-in apply deferred: serving SLO breached")
+            return None
         try:
             self.pull()
         except Exception:
@@ -578,19 +601,33 @@ class FoldInController:
             self._update_pending_gauge()
 
         from predictionio_tpu.deploy.warm import FoldinSwapRaced
+        from predictionio_tpu.obs.tracing import carried
+
+        # re-enter the last tap's trace so this apply (and the swap
+        # inside it) is recorded under the ingest request's trace id
+        ctx, self._last_trace = self._last_trace, None
         try:
-            stats = self._apply(users, items, counts)
+            if ctx is not None:
+                with carried(ctx, "foldin_apply",
+                             registry=self._registry,
+                             attrs={"users": len(users),
+                                    "items": len(items)}):
+                    stats = self._apply(users, items, counts)
+            else:
+                stats = self._apply(users, items, counts)
         except FoldinSwapRaced as e:
             # a reload/deploy/rollback/canary cutover landed mid-solve
             # and won the compare-and-swap — expected under operation,
             # not an error: the next tick re-solves against the NEW unit
             _requeue()
+            self._last_trace = ctx
             self._m_applies.inc(outcome="raced")
             logger.info("fold-in apply raced a deploy cutover, deltas "
                         "requeued: %s", e)
             return None
         except Exception:
             _requeue()
+            self._last_trace = ctx
             self._m_applies.inc(outcome="error")
             raise
         self._m_applies.inc(outcome="applied")
@@ -598,6 +635,12 @@ class FoldInController:
         dt = time.perf_counter() - t_start
         self.last_apply_s = dt
         self._m_apply.observe(dt)
+        from predictionio_tpu.obs.trace_context import record_event
+
+        record_event("foldin_apply", {
+            "users": len(users), "items": len(items),
+            "applySeconds": round(dt, 4)},
+            trace_id=ctx.trace_id if ctx is not None else None)
         now = time.monotonic()
         for ts in list(users.values()) + list(items.values()):
             self._m_latency.observe(max(0.0, now - ts))

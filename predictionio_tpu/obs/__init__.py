@@ -17,34 +17,58 @@ from predictionio_tpu.obs.registry import (
     render_json,
     render_prometheus,
 )
+from predictionio_tpu.obs.trace_context import (
+    TRACE_ENV,
+    TRACE_HEADER,
+    FlightRecorder,
+    TraceContext,
+    child_env,
+    record_event,
+    recorder,
+)
 from predictionio_tpu.obs.tracing import (
     REQUEST_ID_HEADER,
     Trace,
+    adopt,
+    capture_context,
+    carried,
     current_request_id,
     current_trace,
     new_request_id,
     span,
+    tracing_enabled,
 )
 
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
     "PROMETHEUS_CONTENT_TYPE",
     "REQUEST_ID_HEADER",
+    "TRACE_ENV",
+    "TRACE_HEADER",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "Trace",
+    "TraceContext",
+    "adopt",
+    "capture_context",
+    "carried",
+    "child_env",
     "compile_counter",
     "current_request_id",
     "current_trace",
     "default_registry",
     "exponential_buckets",
     "new_request_id",
+    "record_event",
+    "recorder",
     "register_jax_metrics",
     "render_json",
     "render_prometheus",
     "span",
+    "tracing_enabled",
 ]
 
 

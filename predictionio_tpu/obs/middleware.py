@@ -1,4 +1,4 @@
-"""aiohttp observability: request middleware + /metrics endpoints.
+"""aiohttp observability: request middleware + /metrics + /debug routes.
 
 ``observability_middleware(registry, service)`` gives every request a
 request ID (honouring an incoming ``X-Request-ID``), opens a trace for
@@ -8,13 +8,24 @@ tracks in-flight requests, and emits a structured slow-request log line
 when the wall time crosses the threshold (``PIO_SLOW_REQUEST_SECONDS``,
 default 1.0 s).
 
+Cross-process propagation: an incoming ``X-Pio-Trace`` header
+(``trace_id:span_id``) makes the request a CHILD of the carrier's trace
+— the event server's request, a fold-in apply it triggers, and the swap
+that follows all share one trace id. The response echoes the request's
+own context in the same header, and every completed request is recorded
+in the in-memory flight recorder, exposed at ``GET /debug/traces.json``
+(and via ``pio traces``). ``PIO_TRACING=0`` disables the trace layer
+(no contextvars, no recorder writes) while keeping every metric — the
+bench measures tracing overhead against exactly that state.
+
 ``add_metrics_routes(app, *registries)`` mounts ``GET /metrics``
-(Prometheus text exposition 0.0.4) and ``GET /metrics.json`` rendering
-the given registries merged — by convention the server's own registry
-first, then :func:`default_registry` so workflow/JAX process metrics
-ride along on every scrape.  The endpoints are deliberately
-unauthenticated (scrapers hold no access keys); they expose aggregate
-counts only.
+(Prometheus text exposition 0.0.4), ``GET /metrics.json``, and
+``GET /debug/traces.json`` rendering the given registries merged — by
+convention the server's own registry first, then
+:func:`default_registry` so workflow/JAX process metrics ride along on
+every scrape.  The endpoints are deliberately unauthenticated (scrapers
+hold no access keys); they expose aggregate counts and bounded trace
+rings only.
 """
 
 from __future__ import annotations
@@ -29,9 +40,12 @@ from predictionio_tpu.obs.registry import (
     PROMETHEUS_CONTENT_TYPE, MetricsRegistry, default_registry,
     render_json, render_prometheus,
 )
+from predictionio_tpu.obs.trace_context import (
+    TRACE_HEADER, TraceContext, recorder,
+)
 from predictionio_tpu.obs.tracing import (
     REQUEST_ID_HEADER, log_slow_request, new_request_id, reset_trace,
-    span_histogram, start_trace,
+    span_histogram, start_trace, tracing_enabled,
 )
 
 logger = logging.getLogger("pio.obs")
@@ -70,11 +84,17 @@ def observability_middleware(registry: MetricsRegistry, service: str,
         "pio_http_requests_in_flight",
         "Requests currently being handled", labelnames=("service",))
     spans = span_histogram(registry)
+    flight = recorder()
 
     @web.middleware
     async def middleware(request, handler):
         request_id = request.headers.get(REQUEST_ID_HEADER) or new_request_id()
-        tokens, trace = start_trace(request_id, registry, spans)
+        traced = tracing_enabled()
+        tokens = trace = None
+        if traced:
+            parent = TraceContext.decode(request.headers.get(TRACE_HEADER))
+            tokens, trace = start_trace(request_id, registry, spans,
+                                        context=parent)
         in_flight.inc(service=service)
         t0 = time.perf_counter()
         status = 500
@@ -82,6 +102,8 @@ def observability_middleware(registry: MetricsRegistry, service: str,
             response = await handler(request)
             status = response.status
             response.headers[REQUEST_ID_HEADER] = request_id
+            if trace is not None:
+                response.headers[TRACE_HEADER] = trace.context().encode()
             return response
         except web.HTTPException as exc:
             status = exc.status
@@ -98,18 +120,26 @@ def observability_middleware(registry: MetricsRegistry, service: str,
         finally:
             in_flight.dec(service=service)
             dt = time.perf_counter() - t0
+            handler_label = _handler_label(request)
             duration.observe(dt, service=service, method=request.method,
-                             handler=_handler_label(request),
+                             handler=handler_label,
                              status=str(status))
             if dt >= slow_threshold_s:
                 log_slow_request(service, request.method, request.path,
                                  status, dt, trace)
-            reset_trace(tokens)
+            if trace is not None:
+                flight.record_span(
+                    trace_id=trace.trace_id, span_id=trace.span_id,
+                    parent_span_id=trace.parent_span_id,
+                    name=f"{service} {request.method} {handler_label}",
+                    duration_s=dt, spans=trace.spans_by_name(),
+                    status="ok" if status < 500 else "error")
+                reset_trace(tokens)
 
     return middleware
 
 
-METRICS_PATHS = ("/metrics", "/metrics.json")
+METRICS_PATHS = ("/metrics", "/metrics.json", "/debug/traces.json")
 
 
 def add_metrics_routes(app: web.Application,
@@ -124,5 +154,15 @@ def add_metrics_routes(app: web.Application,
     async def handle_metrics_json(request):
         return web.json_response(render_json(regs))
 
+    async def handle_traces(request):
+        trace_id = request.query.get("traceId")
+        try:
+            limit = int(request.query["limit"]) \
+                if "limit" in request.query else None
+        except ValueError:
+            limit = None
+        return web.json_response(recorder().to_json(trace_id, limit))
+
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/metrics.json", handle_metrics_json)
+    app.router.add_get("/debug/traces.json", handle_traces)

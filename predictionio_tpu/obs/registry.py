@@ -40,6 +40,17 @@ DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = tuple(
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+#: per-metric label-series cap: past it, NEW label combinations collapse
+#: into values "other" and pio_obs_label_overflow_total{metric} counts
+#: the overflow — a per-entity or per-query label can never grow the
+#: unauthenticated /metrics exposition without bound. Above the event
+#: server's own 1000-series bookkeeping cap so that guard fires first.
+DEFAULT_MAX_SERIES = 2048
+
+OVERFLOW_COUNTER = "pio_obs_label_overflow_total"
+#: the label value overflowing combinations collapse into
+OVERFLOW_LABEL_VALUE = "other"
+
 
 def exponential_buckets(start: float, factor: float, count: int
                         ) -> Tuple[float, ...]:
@@ -89,6 +100,12 @@ class _Metric:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
+        #: label-cardinality guard (see DEFAULT_MAX_SERIES); the owning
+        #: registry sets the backpointer so overflow can be counted
+        self.max_series = DEFAULT_MAX_SERIES
+        self._registry: Optional["MetricsRegistry"] = None
+        self._overflow_key = tuple(
+            OVERFLOW_LABEL_VALUE for _ in self.labelnames)
 
     def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
         if set(labels) != set(self.labelnames):
@@ -96,6 +113,22 @@ class _Metric:
                 f"{self.name}: expected labels {self.labelnames}, "
                 f"got {tuple(sorted(labels))}")
         return tuple(str(labels[n]) for n in self.labelnames)
+
+    def _guarded_key(self, key: Tuple[str, ...], store: Dict) -> Tuple:
+        """Called UNDER self._lock: the key to actually account against —
+        a new combination past the cap collapses into the overflow
+        bucket. Returns (key, overflowed)."""
+        if (self.labelnames and key not in store
+                and len(store) >= self.max_series):
+            return self._overflow_key, True
+        return key, False
+
+    def _note_overflow(self) -> None:
+        """Called OUTSIDE self._lock (the overflow counter takes its own
+        lock; never hold two metric locks at once)."""
+        reg = self._registry
+        if reg is not None:
+            reg._overflow_counter().inc(metric=self.name)
 
     def signature(self) -> Tuple[str, Tuple[str, ...]]:
         return (self.kind, self.labelnames)
@@ -115,7 +148,16 @@ class Counter(_Metric):
             raise ValueError("counters only go up")
         key = self._key(labels)
         with self._lock:
+            key, overflowed = self._guarded_key(key, self._values)
             self._values[key] = self._values.get(key, 0.0) + amount
+        if overflowed:
+            self._note_overflow()
+
+    def to_snapshot(self) -> dict:
+        return {"kind": self.kind, "help": self.help,
+                "labelnames": list(self.labelnames),
+                "series": [{"labels": labels, "value": value}
+                           for labels, value in self.samples()]}
 
     def value(self, **labels) -> float:
         key = self._key(labels)
@@ -158,15 +200,29 @@ class Gauge(_Metric):
     def set(self, value: float, **labels) -> None:
         key = self._key(labels)
         with self._lock:
+            key, overflowed = self._guarded_key(key, self._values)
             self._values[key] = float(value)
+        if overflowed:
+            self._note_overflow()
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         key = self._key(labels)
         with self._lock:
+            key, overflowed = self._guarded_key(key, self._values)
             self._values[key] = self._values.get(key, 0.0) + amount
+        if overflowed:
+            self._note_overflow()
 
     def dec(self, amount: float = 1.0, **labels) -> None:
         self.inc(-amount, **labels)
+
+    def to_snapshot(self) -> dict:
+        """Callback gauges are evaluated here — a snapshot carries the
+        values a scrape would have seen at this moment."""
+        return {"kind": self.kind, "help": self.help,
+                "labelnames": list(self.labelnames),
+                "series": [{"labels": labels, "value": value}
+                           for labels, value in self.samples()]}
 
     def set_function(self, fn: Callable) -> None:
         """Lazy gauge: `fn()` is evaluated at scrape time and must return
@@ -223,11 +279,65 @@ class Histogram(_Metric):
         key = self._key(labels)
         idx = bisect.bisect_left(self.buckets, value)
         with self._lock:
+            key, overflowed = self._guarded_key(key, self._counts)
             counts = self._counts.get(key)
             if counts is None:
                 counts = self._counts[key] = [0.0] * (len(self.buckets) + 1)
             counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
+        if overflowed:
+            self._note_overflow()
+
+    def count_below(self, threshold: float, **labels) -> float:
+        """Observations <= the bucket bound holding `threshold` (the
+        exact count when `threshold` IS a bucket bound — SLO latency
+        thresholds should be chosen on bucket edges; otherwise the count
+        is for the next bound above). No labels = summed over keys."""
+        idx = bisect.bisect_left(self.buckets, threshold)
+        if labels:
+            keys = [self._key(labels)]
+        else:
+            with self._lock:
+                keys = list(self._counts)
+        total = 0.0
+        with self._lock:
+            for key in keys:
+                counts = self._counts.get(key, ())
+                total += sum(counts[:idx + 1])
+        return total
+
+    def to_snapshot(self) -> dict:
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        return {"kind": self.kind, "help": self.help,
+                "labelnames": list(self.labelnames),
+                "buckets": list(self.buckets),
+                "series": [{"labels": dict(zip(self.labelnames, key)),
+                            "counts": list(counts),
+                            "sum": sums.get(key, 0.0)}
+                           for key, counts in items]}
+
+    def _merge_series(self, labels: Dict[str, str], counts: Sequence[float],
+                      sum_: float) -> None:
+        """Elementwise-add raw per-bucket counts (fleet merge). The
+        caller has verified bucket-bound equality; count vectors are the
+        raw per-bucket layout to_snapshot exports."""
+        key = self._key(labels)
+        if len(counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"{self.name}: snapshot has {len(counts)} buckets, "
+                f"this histogram has {len(self.buckets) + 1}")
+        with self._lock:
+            key, overflowed = self._guarded_key(key, self._counts)
+            mine = self._counts.get(key)
+            if mine is None:
+                mine = self._counts[key] = [0.0] * (len(self.buckets) + 1)
+            for i, c in enumerate(counts):
+                mine[i] += c
+            self._sums[key] = self._sums.get(key, 0.0) + sum_
+        if overflowed:
+            self._note_overflow()
 
     # -- accessors (serving-stats endpoints read these) ----------------------
     def count(self, **labels) -> float:
@@ -328,7 +438,8 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
 
-    def _get_or_create(self, cls, name, help, labelnames, **kwargs):
+    def _get_or_create(self, cls, name, help, labelnames,
+                       max_series=None, **kwargs):
         with self._lock:
             metric = self._metrics.get(name)
             if metric is not None:
@@ -337,18 +448,37 @@ class MetricsRegistry:
                         f"metric {name!r} already registered as "
                         f"{metric.signature()}, requested "
                         f"{(cls.kind, tuple(labelnames))}")
+                if max_series is not None:
+                    metric.max_series = max_series
                 return metric
             metric = cls(name, help, labelnames, **kwargs)
+            metric._registry = self
+            if max_series is not None:
+                metric.max_series = max_series
             self._metrics[name] = metric
             return metric
 
+    def _overflow_counter(self) -> Counter:
+        """The per-metric label-overflow counter (lazily registered so an
+        untouched registry renders exactly what its callers created).
+        Effectively exempt from its own guard: metric names are
+        code-defined and bounded."""
+        return self._get_or_create(
+            Counter, OVERFLOW_COUNTER,
+            "Label combinations collapsed into the 'other' bucket by the "
+            "per-metric series cap", ("metric",), max_series=1 << 31)
+
     def counter(self, name: str, help: str = "",
-                labelnames: Sequence[str] = ()) -> Counter:
-        return self._get_or_create(Counter, name, help, labelnames)
+                labelnames: Sequence[str] = (),
+                max_series: Optional[int] = None) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames,
+                                   max_series=max_series)
 
     def gauge(self, name: str, help: str = "",
-              labelnames: Sequence[str] = ()) -> Gauge:
-        return self._get_or_create(Gauge, name, help, labelnames)
+              labelnames: Sequence[str] = (),
+              max_series: Optional[int] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames,
+                                   max_series=max_series)
 
     def gauge_callback(self, name: str, help: str, fn: Callable,
                        labelnames: Sequence[str] = ()) -> Gauge:
@@ -359,10 +489,10 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "",
                   labelnames: Sequence[str] = (),
-                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
-                  ) -> Histogram:
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
+                  max_series: Optional[int] = None) -> Histogram:
         return self._get_or_create(Histogram, name, help, labelnames,
-                                   buckets=buckets)
+                                   max_series=max_series, buckets=buckets)
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
@@ -398,6 +528,54 @@ class MetricsRegistry:
                     for labels, value in metric.samples()]
             out[metric.name] = entry
         return out
+
+    # -- fleet aggregation (obs/fleet.py rides these) ------------------------
+    def to_snapshot(self) -> dict:
+        """JSON-ready export of every metric's raw state (histograms as
+        raw per-bucket counts, so a merge is exact — not a quantile
+        estimate of an estimate). Callback gauges are evaluated."""
+        return {m.name: m.to_snapshot() for m in self.collect()}
+
+    def merge_snapshot(self, snap: dict,
+                       extra_labels: Optional[Dict[str, str]] = None
+                       ) -> None:
+        """Fold another process's :meth:`to_snapshot` export into this
+        registry, get-or-creating each metric with the snapshot's
+        labelnames extended by ``extra_labels`` (fleet views add
+        ``process``). Counters and histograms ADD (merge is associative
+        and commutative, merge-with-empty is the identity — tested);
+        gauges SET per extended key (point-in-time values: with a
+        distinct ``process`` label per source the keys are disjoint).
+        A histogram whose bucket bounds disagree with an
+        already-registered one raises — silently re-bucketing would
+        corrupt quantiles."""
+        extra = dict(extra_labels or {})
+        for name, entry in snap.items():
+            kind = entry.get("kind")
+            labelnames = tuple(entry.get("labelnames", ())) + tuple(extra)
+            if kind == "counter":
+                m = self.counter(name, entry.get("help", ""), labelnames)
+                for s in entry.get("series", ()):
+                    m.inc(s["value"], **{**s["labels"], **extra})
+            elif kind == "gauge":
+                m = self.gauge(name, entry.get("help", ""), labelnames)
+                for s in entry.get("series", ()):
+                    labels = {**s["labels"], **extra}
+                    if set(labels) != set(labelnames):
+                        continue   # callback gauge with ad-hoc labels
+                    m.set(s["value"], **labels)
+            elif kind == "histogram":
+                buckets = tuple(entry.get("buckets", ()))
+                m = self.histogram(name, entry.get("help", ""), labelnames,
+                                   buckets=buckets or
+                                   DEFAULT_LATENCY_BUCKETS)
+                if tuple(m.buckets) != buckets:
+                    raise ValueError(
+                        f"histogram {name!r}: snapshot buckets "
+                        f"{buckets} != registered {m.buckets}")
+                for s in entry.get("series", ()):
+                    m._merge_series({**s["labels"], **extra},
+                                    s["counts"], s.get("sum", 0.0))
 
 
 def render_prometheus(registries: Iterable[MetricsRegistry]) -> str:

@@ -1,0 +1,241 @@
+"""Cross-process trace propagation + the in-memory flight recorder.
+
+PR 1 gave every HTTP request a request id and a contextvar trace, but
+the system has since become a *fleet*: writer threads, micro-batch
+executors, fold-in applies, multi-process batchpredict/train shards.
+Each of those hops used to start fresh — the one id that should stitch
+an event from ingest through fold-in apply to the serving swap (or a
+batchpredict parent run to its shard processes) was dropped at every
+boundary.
+
+Two pieces close that:
+
+* :class:`TraceContext` — a compact ``trace_id:span_id`` pair carried on
+  every internal hop: HTTP requests propagate it via the
+  ``X-Pio-Trace`` header, spawned shard processes inherit it via the
+  ``PIO_TRACE_CONTEXT`` env var (see :func:`child_env`), and thread
+  hops (WriteBuffer's writer thread, the MicroBatcher executor, the
+  fold-in apply) carry it explicitly via ``tracing.capture_context()``
+  + ``tracing.carried()``.
+
+* :class:`FlightRecorder` — a bounded in-memory ring of recently
+  completed traces plus a second ring of lifecycle events (deploys,
+  swaps, fold-in applies, canary verdicts, SLO breaches), exposed at
+  ``GET /debug/traces.json`` on every server and via ``pio traces``.
+  Shard processes export their records in their obs snapshot
+  (obs/fleet.py) so the merger's recorder shows one trace id spanning
+  the parent and every shard.
+
+Dependency-free by design (no aiohttp, no jax): storage and CLI paths
+participate without pulling server deps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, List, Optional
+
+#: env var a parent run sets for spawned shard processes
+TRACE_ENV = "PIO_TRACE_CONTEXT"
+#: HTTP header carrying the encoded context between servers
+TRACE_HEADER = "X-Pio-Trace"
+
+#: ring capacities — bounded by construction, a recorder can never grow
+#: /debug/traces.json without limit
+DEFAULT_TRACE_CAPACITY = 256
+DEFAULT_EVENT_CAPACITY = 256
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """The wire form of "where in which trace am I": a trace id plus the
+    span id of the hop that carried it (the receiver's parent span)."""
+
+    trace_id: str
+    span_id: str
+
+    def encode(self) -> str:
+        return f"{self.trace_id}:{self.span_id}"
+
+    @classmethod
+    def decode(cls, raw: Optional[str]) -> Optional["TraceContext"]:
+        """Parse an encoded context; malformed input returns None (a bad
+        header or env var must never fail a request or a job)."""
+        if not raw:
+            return None
+        parts = raw.strip().split(":")
+        if len(parts) != 2 or not parts[0] or not parts[1]:
+            return None
+        if not all(c.isalnum() or c in "-_" for c in parts[0] + parts[1]):
+            return None
+        return cls(parts[0][:64], parts[1][:64])
+
+    def child(self) -> "TraceContext":
+        """A fresh span under the same trace (what a hop hands onward)."""
+        return TraceContext(self.trace_id, new_span_id())
+
+    @classmethod
+    def root(cls) -> "TraceContext":
+        return cls(new_trace_id(), new_span_id())
+
+
+def from_env(environ=None) -> Optional[TraceContext]:
+    """The context a parent process handed this one, if any."""
+    return TraceContext.decode((environ or os.environ).get(TRACE_ENV))
+
+
+def child_env(ctx: Optional[TraceContext], base: Optional[dict] = None
+              ) -> dict:
+    """A copy of ``base`` (default: os.environ) with ``PIO_TRACE_CONTEXT``
+    set to a child span of ``ctx`` — the env a parent run gives a spawned
+    shard process so one trace id spans the whole fleet."""
+    env = dict(base if base is not None else os.environ)
+    if ctx is not None:
+        env[TRACE_ENV] = ctx.child().encode()
+    return env
+
+
+class FlightRecorder:
+    """Bounded ring buffers of recent traces + lifecycle events.
+
+    Thread-safe; records are plain dicts (JSON-ready). Traces land here
+    when a request/job/flush completes (obs/middleware.py,
+    tracing.carried, workflow adoption); lifecycle events are recorded
+    by the deploy/fold-in/canary/SLO paths at their decision points,
+    each stamped with the trace id active at the time so the two rings
+    cross-reference."""
+
+    def __init__(self, capacity: int = DEFAULT_TRACE_CAPACITY,
+                 event_capacity: int = DEFAULT_EVENT_CAPACITY):
+        self._lock = threading.Lock()
+        self._traces: "deque[dict]" = deque(maxlen=max(1, capacity))
+        self._events: "deque[dict]" = deque(maxlen=max(1, event_capacity))
+
+    # -- traces --------------------------------------------------------------
+    def record_trace(self, record: dict) -> None:
+        with self._lock:
+            self._traces.append(record)
+
+    def record_span(self, *, trace_id: str, span_id: str,
+                    parent_span_id: Optional[str], name: str,
+                    duration_s: float, spans: Optional[Dict] = None,
+                    status: str = "ok", process: Optional[str] = None,
+                    attrs: Optional[dict] = None) -> dict:
+        record = {
+            "traceId": trace_id,
+            "spanId": span_id,
+            "parentSpanId": parent_span_id,
+            "name": name,
+            "ts": time.time(),
+            "durationSec": round(duration_s, 6),
+            "spans": {k: round(v, 6) for k, v in (spans or {}).items()},
+            "status": status,
+            "process": process if process is not None else _process_label(),
+        }
+        if attrs:
+            record["attrs"] = attrs
+        self.record_trace(record)
+        return record
+
+    # -- lifecycle events ----------------------------------------------------
+    def record_event(self, kind: str, detail: Optional[dict] = None,
+                     trace_id: Optional[str] = None) -> dict:
+        """One lifecycle event (deploy, swap, fold-in apply, canary
+        verdict, SLO breach, ...), stamped with the active trace id when
+        none is given."""
+        if trace_id is None:
+            # late import: tracing imports this module, not vice versa
+            from predictionio_tpu.obs import tracing
+
+            trace = tracing.current_trace()
+            trace_id = trace.trace_id if trace is not None else None
+        # reserved fields win over detail keys (a detail carrying "kind"
+        # must not relabel the event)
+        record = {**(detail or {}), "kind": kind, "ts": time.time(),
+                  "traceId": trace_id, "process": _process_label()}
+        with self._lock:
+            self._events.append(record)
+        return record
+
+    # -- readout -------------------------------------------------------------
+    def traces(self, trace_id: Optional[str] = None,
+               limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._traces)
+        if trace_id is not None:
+            out = [t for t in out if t.get("traceId") == trace_id]
+        if limit is not None:
+            out = out[-limit:]
+        return out
+
+    def events(self, limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._events)
+        if limit is not None:
+            out = out[-limit:]
+        return out
+
+    def import_records(self, traces: List[dict], events: List[dict],
+                       process: Optional[str] = None) -> None:
+        """Merge another process's exported rings (fleet aggregation:
+        shard obs snapshots land in the merger's recorder so one trace
+        id spans parent + shards)."""
+        with self._lock:
+            for t in traces or ():
+                entry = dict(t)
+                if process is not None:
+                    entry.setdefault("process", process)
+                self._traces.append(entry)
+            for e in events or ():
+                entry = dict(e)
+                if process is not None:
+                    entry.setdefault("process", process)
+                self._events.append(entry)
+
+    def to_json(self, trace_id: Optional[str] = None,
+                limit: Optional[int] = None) -> dict:
+        return {"traces": self.traces(trace_id, limit),
+                "events": self.events(limit)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+            self._events.clear()
+
+
+def _process_label() -> str:
+    """This process's identity in fleet views: the PIO_* shard contract
+    when present, else the bare pid."""
+    if "PIO_NUM_PROCESSES" in os.environ:
+        rank = os.environ.get("PIO_PROCESS_ID", "0")
+        size = os.environ.get("PIO_NUM_PROCESSES")
+        return f"{rank}/{size}"
+    return str(os.getpid())
+
+
+_recorder = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    """The process-global flight recorder (servers expose it at
+    /debug/traces.json; workflows and lifecycle paths record into it)."""
+    return _recorder
+
+
+def record_event(kind: str, detail: Optional[dict] = None,
+                 trace_id: Optional[str] = None) -> dict:
+    """Convenience: record a lifecycle event on the global recorder."""
+    return _recorder.record_event(kind, detail, trace_id)

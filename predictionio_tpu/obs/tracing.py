@@ -1,4 +1,4 @@
-"""Request tracing: request IDs + contextvar span API.
+"""Request tracing: request IDs + contextvar span API + cross-hop carry.
 
 Every request through the observability middleware gets a request ID
 (taken from an incoming ``X-Request-ID`` header or generated) and an
@@ -6,6 +6,17 @@ active :class:`Trace` carried in a :mod:`contextvars` context, so
 ``span("predict")`` anywhere below the handler records a named stage
 timing without threading arguments through every signature — the same
 pattern as ``utils.profiling.phase`` but per-request and async-safe.
+
+Beyond the original per-request contextvar, a trace now has an IDENTITY
+that survives process and thread boundaries (obs/trace_context.py): a
+``trace_id``/``span_id`` pair. Thread hops that used to drop the
+request's trace (the WriteBuffer writer thread, the MicroBatcher
+executor, the fold-in apply) capture it with :func:`capture_context`
+and re-enter it on the worker thread with :func:`carried`, so the
+flush/batch span is linked to the submitting request in the flight
+recorder. Whole processes adopt a parent's context from the
+``PIO_TRACE_CONTEXT`` env var with :func:`adopt` (batchpredict/train
+shards), so one trace id stitches a fleet run end to end.
 
 Span timings feed two places: the active trace (surfaced in structured
 slow-request log lines) and the owning registry's
@@ -18,15 +29,29 @@ import contextlib
 import contextvars
 import json
 import logging
+import os
 import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
 from predictionio_tpu.obs.registry import MetricsRegistry
+from predictionio_tpu.obs.trace_context import (
+    TraceContext, new_span_id, recorder,
+)
 
 logger = logging.getLogger("pio.obs")
 
 REQUEST_ID_HEADER = "X-Request-ID"
+
+#: env kill-switch for the tracing layer (metrics stay on): the bench
+#: measures its overhead against exactly this off state
+TRACING_ENV = "PIO_TRACING"
+
+
+def tracing_enabled() -> bool:
+    return os.environ.get(TRACING_ENV, "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
 
 _request_id_var: contextvars.ContextVar[Optional[str]] = \
     contextvars.ContextVar("pio_request_id", default=None)
@@ -54,19 +79,30 @@ def span_histogram(registry: MetricsRegistry):
 
 
 class Trace:
-    """Per-request span accumulator."""
+    """Per-request (or per-job/per-hop) span accumulator with identity."""
 
-    __slots__ = ("request_id", "registry", "span_hist", "spans")
+    __slots__ = ("request_id", "registry", "span_hist", "spans",
+                 "trace_id", "span_id", "parent_span_id")
 
     def __init__(self, request_id: str,
                  registry: Optional[MetricsRegistry] = None,
-                 span_hist=None):
+                 span_hist=None,
+                 context: Optional[TraceContext] = None):
         self.request_id = request_id
         self.registry = registry
         #: pre-resolved pio_span_duration_seconds handle — span() exits on
         #: the query hot path must not take the registry lock per call
         self.span_hist = span_hist
         self.spans: List[Tuple[str, float]] = []
+        # identity: adopt the carried context (this hop is a child of the
+        # carrier), else the request id IS the trace id (root)
+        if context is not None:
+            self.trace_id = context.trace_id
+            self.parent_span_id = context.span_id
+        else:
+            self.trace_id = request_id
+            self.parent_span_id = None
+        self.span_id = new_span_id()
 
     def add(self, name: str, seconds: float) -> None:
         self.spans.append((name, seconds))
@@ -77,13 +113,19 @@ class Trace:
             out[name] = out.get(name, 0.0) + seconds
         return out
 
+    def context(self) -> TraceContext:
+        """This trace's position as a carryable context (the hop a child
+        span/process attaches under)."""
+        return TraceContext(self.trace_id, self.span_id)
+
 
 def start_trace(request_id: str,
                 registry: Optional[MetricsRegistry] = None,
-                span_hist=None):
+                span_hist=None,
+                context: Optional[TraceContext] = None):
     """Install a fresh trace + request id; returns tokens for
     :func:`reset_trace`."""
-    trace = Trace(request_id, registry, span_hist)
+    trace = Trace(request_id, registry, span_hist, context=context)
     return (_request_id_var.set(request_id), _trace_var.set(trace)), trace
 
 
@@ -91,6 +133,63 @@ def reset_trace(tokens) -> None:
     rid_token, trace_token = tokens
     _request_id_var.reset(rid_token)
     _trace_var.reset(trace_token)
+
+
+def capture_context() -> Optional[TraceContext]:
+    """The active trace's carryable context (None outside a trace) — the
+    cheap contextvar read a submit path does so a worker thread can later
+    :func:`carried` into the same trace."""
+    trace = _trace_var.get()
+    return trace.context() if trace is not None else None
+
+
+@contextlib.contextmanager
+def carried(context: Optional[TraceContext], name: str,
+            registry: Optional[MetricsRegistry] = None,
+            span_hist=None, record: bool = True,
+            attrs: Optional[dict] = None):
+    """Re-enter a captured trace context on another thread.
+
+    Installs a child Trace of ``context`` (or a fresh root when the
+    submitter had none) named ``name``; ``span()`` calls inside link to
+    the originating request's trace id, and on exit the hop is recorded
+    in the flight recorder (``record=False`` skips — e.g. per-batch hops
+    that would flood the ring under load record selectively)."""
+    rid = context.trace_id if context is not None else new_request_id()
+    tokens, trace = start_trace(rid, registry, span_hist, context=context)
+    t0 = time.perf_counter()
+    status = "ok"
+    try:
+        yield trace
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        reset_trace(tokens)
+        if record:
+            recorder().record_span(
+                trace_id=trace.trace_id, span_id=trace.span_id,
+                parent_span_id=trace.parent_span_id, name=name,
+                duration_s=time.perf_counter() - t0,
+                spans=trace.spans_by_name(), status=status, attrs=attrs)
+
+
+@contextlib.contextmanager
+def adopt(name: str, context: Optional[TraceContext] = None,
+          registry: Optional[MetricsRegistry] = None,
+          attrs: Optional[dict] = None):
+    """Run a whole job (train, eval, a batchpredict shard) as one trace.
+
+    ``context=None`` reads ``PIO_TRACE_CONTEXT`` from the environment —
+    a shard spawned by a parent run joins the parent's trace; a
+    standalone run becomes a root. The job is recorded in the flight
+    recorder on exit either way."""
+    if context is None:
+        from predictionio_tpu.obs.trace_context import from_env
+
+        context = from_env()
+    with carried(context, name, registry=registry, attrs=attrs) as trace:
+        yield trace
 
 
 @contextlib.contextmanager
@@ -122,6 +221,7 @@ def log_slow_request(service: str, method: str, path: str, status: int,
     OBSERVABILITY.md for the format contract)."""
     payload = {
         "requestId": trace.request_id if trace else None,
+        "traceId": trace.trace_id if trace else None,
         "service": service,
         "method": method,
         "path": path,

@@ -15,7 +15,9 @@ micro-batch hot path bounds at ``bucketing.bucket_count(max_batch)``
 
 from __future__ import annotations
 
+import functools
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, Hashable, List, Tuple
 
@@ -27,6 +29,31 @@ _LOCK = threading.Lock()
 MAX_PER_FAMILY = 8
 
 
+def _attributed(family: str, fn: Callable) -> Callable:
+    """Per-family dispatch-time attribution (obs/profiler.py): each call
+    of a cached compiled function adds its dispatch wall time to
+    ``pio_device_dispatch_seconds_total{family}`` — the "which compiled
+    family is eating the device" answer. One perf_counter pair + one
+    counter add per dispatch; PIO_DISPATCH_ATTRIBUTION=0 skips the wrap
+    entirely (zero overhead)."""
+    from predictionio_tpu.obs.profiler import (
+        dispatch_attribution_enabled, dispatch_counter,
+    )
+
+    if not dispatch_attribution_enabled():
+        return fn
+    counter = dispatch_counter()
+
+    @functools.wraps(fn)
+    def dispatch(*args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            counter.inc(time.perf_counter() - t0, family=family)
+    return dispatch
+
+
 def _cached(family: str, key: Hashable, build: Callable[[], Callable],
             max_entries: int) -> Callable:
     with _LOCK:
@@ -35,7 +62,7 @@ def _cached(family: str, key: Hashable, build: Callable[[], Callable],
         if fn is not None:
             cache.move_to_end(key)
             return fn
-    fn = build()
+    fn = _attributed(family, build())
     from predictionio_tpu.obs.jax_stats import compile_counter
 
     with _LOCK:

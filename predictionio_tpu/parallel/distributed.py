@@ -111,6 +111,27 @@ def resolve_worker(rank: Optional[int] = None,
     return 0, 1
 
 
+def worker_env(rank: int, size: int, base: Optional[dict] = None,
+               trace_context=None) -> dict:
+    """The environment for spawning one shard of a fleet run: the
+    ``PIO_PROCESS_ID``/``PIO_NUM_PROCESSES`` contract plus the parent's
+    trace context as ``PIO_TRACE_CONTEXT`` (obs/trace_context.py), so
+    one trace id spans the parent and every shard it launches. The
+    parent's context defaults to whatever trace is active at call time
+    (``tracing.adopt`` the parent run first); pass ``trace_context``
+    explicitly to pin one."""
+    if not 0 <= rank < size:
+        raise ValueError(f"worker rank {rank} outside [0, {size})")
+    from predictionio_tpu.obs.trace_context import child_env
+    from predictionio_tpu.obs.tracing import capture_context
+
+    ctx = trace_context if trace_context is not None else capture_context()
+    env = child_env(ctx, base)
+    env["PIO_PROCESS_ID"] = str(rank)
+    env["PIO_NUM_PROCESSES"] = str(size)
+    return env
+
+
 def contiguous_range(n: int, rank: int, size: int) -> "tuple[int, int]":
     """Row range [lo, hi) owned by `rank` of `size` over `n` rows:
     contiguous, disjoint, covering, balanced to within one row (the

@@ -13,6 +13,10 @@ Deploy-lifecycle extension (no reference counterpart):
 
   GET    /cmd/releases          -> all release manifests (deploy/ registry);
                                    ?engineId=&engineVariant= filters
+  GET    /cmd/slo               -> the operator's SLO fleet view: this
+                                   host's configured spec plus, with
+                                   ?targets=host:port[,host:port...],
+                                   each query server's live /slo.json
 """
 
 from __future__ import annotations
@@ -144,6 +148,53 @@ async def handle_releases(request):
     return web.json_response({"status": 1, "releases": await _run(_list)})
 
 
+async def handle_slo(request):
+    """The SLO fleet view: the host's configured objectives, and — when
+    ``?targets=host:port,...`` names live query servers — each target's
+    current /slo.json evaluation, so one admin call answers "is any
+    release burning its budget" across the fleet."""
+    import aiohttp
+
+    from predictionio_tpu.obs.slo import slo_spec_from_server_json
+
+    spec = slo_spec_from_server_json()
+    out = {
+        "status": 1,
+        "spec": ({
+            "objectives": [{
+                "name": o.name, "kind": o.kind,
+                "thresholdS": o.threshold_s, "budget": o.budget}
+                for o in spec.objectives],
+            "windows": [{"seconds": w.seconds,
+                         "burnThreshold": w.burn_threshold}
+                        for w in spec.windows],
+            "evalIntervalS": spec.eval_interval_s,
+        } if spec is not None else None),
+    }
+    raw_targets = request.query.get("targets", "")
+    targets = [t.strip() for t in raw_targets.split(",") if t.strip()][:32]
+    if targets:
+        timeout = aiohttp.ClientTimeout(total=5)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+
+            async def _fetch(target):
+                try:
+                    async with session.get(
+                            f"http://{target}/slo.json") as resp:
+                        return target, await resp.json()
+                except Exception as e:
+                    return target, {"error": str(e)}
+
+            # concurrent: the view answers in one slowest-target timeout,
+            # not the sum over dead targets
+            results = await asyncio.gather(*[_fetch(t) for t in targets])
+        fleet = dict(results)
+        out["fleet"] = fleet
+        out["breached"] = [t for t, s in fleet.items()
+                           if isinstance(s, dict) and s.get("breached")]
+    return web.json_response(out)
+
+
 def create_admin_server(registry: MetricsRegistry = None) -> web.Application:
     registry = registry or MetricsRegistry()
     app = web.Application(middlewares=[
@@ -154,6 +205,7 @@ def create_admin_server(registry: MetricsRegistry = None) -> web.Application:
     app.router.add_delete("/cmd/app/{name}", handle_app_delete)
     app.router.add_delete("/cmd/app/{name}/data", handle_app_data_delete)
     app.router.add_get("/cmd/releases", handle_releases)
+    app.router.add_get("/cmd/slo", handle_slo)
     add_metrics_routes(app, registry, default_registry())
     return app
 

@@ -69,7 +69,11 @@ from predictionio_tpu.deploy.warm import (
 from predictionio_tpu.obs.jax_stats import register_jax_metrics
 from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
-from predictionio_tpu.obs.tracing import span, span_histogram
+from predictionio_tpu.obs.slo import SLOEngine, SLOSpec
+from predictionio_tpu.obs.trace_context import record_event
+from predictionio_tpu.obs.tracing import (
+    capture_context, carried, current_trace, span, span_histogram,
+)
 from predictionio_tpu.ops.bucketing import bucket_size, padding_waste
 from predictionio_tpu.server.plugins import PluginContext
 from predictionio_tpu.storage.base import EngineInstance, Release, generate_id
@@ -98,12 +102,18 @@ def _stage(hist, name: str):
     """Stage timing against a PRE-RESOLVED span histogram handle —
     `span(..., registry=...)` would re-resolve the histogram under the
     registry lock on every exit, which has no place on the hot path
-    (the tracing.Trace.span_hist rule)."""
+    (the tracing.Trace.span_hist rule). When the executor thread runs
+    under a carried request trace (MicroBatcher dispatch), the stage
+    also lands in that trace so the flight recorder attributes it."""
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        hist.observe(time.perf_counter() - t0, span=name)
+        dt = time.perf_counter() - t0
+        hist.observe(dt, span=name)
+        trace = current_trace()
+        if trace is not None:
+            trace.add(name, dt)
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -181,6 +191,7 @@ class MicroBatcher:
         self._inflight_now = 0
         self._ewma_interval: Optional[float] = None
         self._last_arrival: Optional[float] = None
+        self._registry = registry
         self._size_hist = self._inflight_gauge = self._span_hist = None
         if registry is not None:
             self._size_hist = registry.histogram(
@@ -248,7 +259,11 @@ class MicroBatcher:
         loop = asyncio.get_running_loop()
         self._note_arrival()
         fut = loop.create_future()
-        entry = (query, fut)
+        # capture the submitting request's trace context so the executor
+        # thread's batch spans stay linked to it (the thread hop used to
+        # drop the contextvar trace); a cheap contextvar read, None when
+        # tracing is off
+        entry = (query, fut, capture_context())
         while True:
             if self._task is None or self._task.done():
                 self._queue = asyncio.Queue()
@@ -290,9 +305,13 @@ class MicroBatcher:
                             batch.append(queue.get_nowait())
                     if self._size_hist is not None:
                         self._size_hist.observe(float(len(batch)))
-                    queries = [q for q, _ in batch]
+                    queries = [q for q, _, _ in batch]
+                    # the batch runs under the FIRST traced submitter's
+                    # context (coalesced siblings ride the same batch)
+                    ctx = next((c for _, _, c in batch if c is not None),
+                               None)
                     ex_fut = loop.run_in_executor(
-                        self._executor, self._predict_batch, queries)
+                        self._executor, self._run_batch, queries, ctx)
                     self._inflight_now += 1
                     if self._inflight_gauge is not None:
                         self._inflight_gauge.set(float(self._inflight_now))
@@ -310,10 +329,22 @@ class MicroBatcher:
             # their executor-future callbacks
             while not queue.empty():
                 batch.append(queue.get_nowait())
-            for _, fut in batch:
+            for _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(
                         RuntimeError("query micro-batch worker stopped"))
+
+    def _run_batch(self, queries, ctx):
+        """Executor-side batch dispatch, re-entering the submitting
+        request's trace when one was captured — the serving_batch hop
+        (and its batch_* stage spans) land in the flight recorder under
+        the request's trace id."""
+        if ctx is None:
+            return self._predict_batch(queries)
+        with carried(ctx, "serving_batch", registry=self._registry,
+                     span_hist=self._span_hist,
+                     attrs={"batch": len(queries)}):
+            return self._predict_batch(queries)
 
     def _finish_batch(self, batch, sem: asyncio.Semaphore, ex_fut) -> None:
         """Runs on the event loop when a dispatched batch's executor
@@ -329,7 +360,7 @@ class MicroBatcher:
             err = e if isinstance(e, Exception) else \
                 RuntimeError(f"micro-batch dispatch failed: {e!r}")
             results = [err] * len(batch)
-        for (_, fut), res in zip(batch, results):
+        for (_, fut, _), res in zip(batch, results):
             if fut.done():
                 continue
             if isinstance(res, Exception):
@@ -360,7 +391,8 @@ class QueryServer:
                  serving_config: Optional[ServingConfig] = None,
                  deploy_config: Optional[DeployConfig] = None,
                  release: Optional[Release] = None,
-                 foldin_config: Optional[FoldinConfig] = None):
+                 foldin_config: Optional[FoldinConfig] = None,
+                 slo_spec: Optional[SLOSpec] = None):
         self.engine = engine
         self.feedback = feedback
         self.feedback_app_name = feedback_app_name
@@ -450,9 +482,16 @@ class QueryServer:
         self._reload_total = self.registry.counter(
             "pio_reload_total", "Model reload attempts by outcome",
             labelnames=("status",))
+        #: SLO burn-rate engine (obs/slo.py) when the host configured a
+        #: server.json "slo" section — evaluated periodically on the loop
+        #: and on-demand at /slo.json; canary + fold-in gating consume it
+        self._slo = (SLOEngine(self.registry, slo_spec)
+                     if slo_spec is not None else None)
+        self._slo_task: Optional[asyncio.Task] = None
         self.app = web.Application(middlewares=[
             observability_middleware(self.registry, "query_server")])
         self.app.on_startup.append(self._on_startup_foldin)
+        self.app.on_startup.append(self._on_startup_slo)
         self.app.on_cleanup.append(self._on_cleanup)
         self._routes()
 
@@ -477,7 +516,33 @@ class QueryServer:
                     self.foldin_config.apply_interval_s,
                     self.foldin_config.max_pending)
 
+    async def _on_startup_slo(self, app) -> None:
+        """Periodic SLO evaluation: burn-rate gauges and breach events
+        update every eval interval even when nothing reads /slo.json."""
+        if self._slo is None:
+            return
+
+        async def _loop():
+            interval = self._slo.spec.eval_interval_s
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    self._slo.tick()
+                except Exception:
+                    logger.exception("SLO evaluation failed")
+
+        self._slo_task = asyncio.get_running_loop().create_task(_loop())
+        logger.info("SLO engine armed: %d objective(s), eval every %.2fs",
+                    len(self._slo.spec.objectives),
+                    self._slo.spec.eval_interval_s)
+
     async def _on_cleanup(self, app) -> None:
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+            try:
+                await self._slo_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._foldin is not None:
             await self._foldin.aclose()
         # settle the deploy background tasks first (a mid-drain
@@ -511,6 +576,8 @@ class QueryServer:
         r.add_get("/deploy/status.json", self.handle_deploy_status)
         r.add_post("/deploy.json", self.handle_deploy)
         r.add_post("/rollback.json", self.handle_rollback)
+        r.add_get("/slo.json", self.handle_slo)
+        r.add_post("/debug/profile", self.handle_profile)
         add_metrics_routes(self.app, self.registry, default_registry())
 
     # -- serving-unit plumbing (deploy/ subsystem) ---------------------------
@@ -933,6 +1000,10 @@ class QueryServer:
                 self._unit = unit
         self._deploy.swap_total.inc(mode=mode, outcome="ok")
         self._deploy.active_version.set(float(unit.release_version))
+        record_event("swap", {
+            "mode": mode, "reason": reason,
+            "engineInstanceId": unit.instance.id,
+            "releaseVersion": unit.release_version or None})
         self._standby = old
         self._spawn(self._retire_batcher(old))
         self._set_release_status(unit.release, "LIVE", reason)
@@ -999,6 +1070,11 @@ class QueryServer:
                 self._unit = unit
         self._deploy.swap_total.inc(mode="foldin", outcome="ok")
         self._deploy.active_version.set(float(unit.release_version))
+        record_event("swap", {
+            "mode": "foldin",
+            "engineInstanceId": unit.instance.id,
+            "releaseVersion": unit.release_version or None,
+            "foldinRows": unit.foldin_rows})
         self._standby = unit.foldin_of
         if loop is not None and loop.is_running():
             fut = asyncio.run_coroutine_threadsafe(
@@ -1062,6 +1138,10 @@ class QueryServer:
             return
         self._canary = None
         self._deploy.canary_fraction.set(0.0)
+        record_event("canary_verdict", {
+            "decision": decision, "reason": reason,
+            "engineInstanceId": canary.unit.instance.id,
+            "releaseVersion": canary.unit.release_version or None})
         if decision == "promote":
             self._deploy.promote_total.inc(
                 reason="healthy" if reason.startswith("healthy") else reason)
@@ -1183,6 +1263,11 @@ class QueryServer:
             self._set_release_status(release, "CANARY",
                                      "shadow" if cfg.shadow else
                                      f"fraction={controller.config.fraction}")
+            record_event("canary_start", {
+                "engineInstanceId": instance.id,
+                "releaseVersion": unit.release_version or None,
+                "shadow": cfg.shadow,
+                "fraction": controller.config.fraction})
             return web.json_response({
                 "message": "Canary started",
                 "engineInstanceId": instance.id,
@@ -1374,6 +1459,60 @@ class QueryServer:
     async def handle_plugins(self, request):
         return web.json_response({"plugins": self.plugins.describe()})
 
+    # -- SLO + profiling surface (obs/slo.py, obs/profiler.py) ---------------
+    async def handle_slo(self, request):
+        """The burn-rate engine's current evaluation; a read also ticks
+        the engine so a breach is visible within one evaluation window
+        even between periodic ticks."""
+        if self._slo is None:
+            return web.json_response({
+                "enabled": False,
+                "message": 'no SLO spec configured (server.json "slo")'})
+        try:
+            status = self._slo.tick()
+        except Exception as e:
+            logger.exception("SLO evaluation failed")
+            return web.json_response({"enabled": True, "error": str(e)},
+                                     status=500)
+        return web.json_response({
+            "enabled": True,
+            "release": {
+                "engineInstanceId": self.instance.id,
+                "releaseVersion": self._unit.release_version or None,
+            },
+            **status})
+
+    async def handle_profile(self, request):
+        """Bounded on-demand device profile (key-auth like the deploy
+        API): a jax.profiler capture plus the per-family dispatch-time
+        attribution table."""
+        from predictionio_tpu.obs import profiler
+
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        try:
+            body = await request.json() if request.can_read_body else {}
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            seconds = float(body.get("seconds", 1.0) or 1.0)
+            outdir = body.get("dir")
+        except (json.JSONDecodeError, TypeError, ValueError) as e:
+            return web.json_response({"message": str(e)}, status=400)
+        loop = asyncio.get_running_loop()
+        try:
+            # the capture sleeps for its whole window: run it on the
+            # deploy lane so neither the event loop nor a predict slot
+            # blocks for the duration
+            out = await loop.run_in_executor(
+                self._deploy_executor, profiler.capture, seconds, outdir)
+        except profiler.ProfileBusy as e:
+            return web.json_response({"message": str(e)}, status=409)
+        except RuntimeError as e:
+            return web.json_response({"message": str(e)}, status=501)
+        record_event("profile_capture", {"seconds": out["seconds"],
+                                         "traceDir": out["traceDir"]})
+        return web.json_response(out)
+
 
 def _raise_shutdown():
     raise web.GracefulExit()
@@ -1411,6 +1550,10 @@ def run_query_server(engine: Engine, train_result: TrainResult,
     # online fold-in knobs from server.json "foldin" + PIO_FOLDIN_* env
     # (pio deploy passes an engine.json-aware config explicitly)
     kwargs.setdefault("foldin_config", cfg.foldin)
+    # per-release SLO objectives from server.json "slo" (PIO_SLO=0 off)
+    from predictionio_tpu.obs.slo import slo_spec_from_server_json
+
+    kwargs.setdefault("slo_spec", slo_spec_from_server_json())
     server = create_query_server(engine, train_result, instance, ctx, **kwargs)
     ssl_ctx = cfg.ssl_context()
     logger.info("Query server listening on %s:%s%s", ip, port,

@@ -65,8 +65,10 @@ from predictionio_tpu.core.base import FirstServing, Serving
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.params import params_from_json
 from predictionio_tpu.obs import batch_stats
+from predictionio_tpu.obs import fleet as obs_fleet
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
-from predictionio_tpu.obs.tracing import span
+from predictionio_tpu.obs.trace_context import from_env, recorder
+from predictionio_tpu.obs.tracing import carried, span
 from predictionio_tpu.ops.bucketing import bucket_size, padding_waste
 from predictionio_tpu.parallel.distributed import (
     contiguous_range, resolve_worker,
@@ -100,6 +102,12 @@ class BatchPredictReport:
     merged: bool = True          # False = this shard left a fragment only
     total_written: Optional[int] = None   # across shards (merger only)
     total_invalid: Optional[int] = None
+    #: the run's trace id (PIO_TRACE_CONTEXT parent, else a fresh root);
+    #: one id spans the parent and every shard of a fleet run
+    trace_id: Optional[str] = None
+    #: merged fleet observability (merger only): per-process metrics with
+    #: a `process` label, exact counter totals, the fleet's trace records
+    fleet: Optional[dict] = None
 
 
 # ---------------------------------------------------------------------------
@@ -640,6 +648,14 @@ def _part_path(output: str, rank: int, size: int) -> str:
     return f"{output}.part-{rank:05d}-of-{size:05d}"
 
 
+def _obs_path(output: str, rank: int, size: int) -> str:
+    return f"{output}.obs-{rank:05d}-of-{size:05d}.json"
+
+
+def _fleet_path(output: str) -> str:
+    return f"{output}.fleet.json"
+
+
 def _err_part_path(output: str, rank: int, size: int) -> str:
     return f"{output}.errors.part-{rank:05d}-of-{size:05d}"
 
@@ -702,7 +718,8 @@ def _clear_stale_rank_markers(output: str, rank: int, size: int) -> None:
     a sibling's live markers from the same fleet stay usable."""
     for path in (_meta_path(output, rank, size),
                  _part_path(output, rank, size),
-                 _err_part_path(output, rank, size)):
+                 _err_part_path(output, rank, size),
+                 _obs_path(output, rank, size)):
         try:
             os.unlink(path)
         except OSError:
@@ -819,6 +836,38 @@ def _do_merge(output: str, size: int, fmt: str, entries: List[dict]) -> dict:
             return totals
         raise
 
+    # fleet observability merge: fold every shard's obs snapshot into
+    # ONE view (per-process labels, exact counter sums, the union of
+    # trace records) committed as <output>.fleet.json, and import the
+    # fleet's traces into THIS process's flight recorder so one trace id
+    # spans parent + shards at /debug/traces.json. Snapshots already
+    # GC'd by a previous merge leave the committed fleet.json in place.
+    obs_paths = [p for p in (_obs_path(output, r, size)
+                             for r in range(size)) if os.path.exists(p)]
+    try:
+        # best-effort by contract: the predictions are already committed,
+        # and a bad shard snapshot (mixed code versions skewing histogram
+        # buckets, a malformed series) must never fail the data path —
+        # or leave the manifest claim wedged for every future fleet
+        view = obs_fleet.merge_snapshot_files(obs_paths)
+        if view.processes:
+            fleet_doc = view.to_json()
+            ftmp = f"{_fleet_path(output)}.tmp-{uuid.uuid4().hex}"
+            try:
+                with open(ftmp, "w") as f:
+                    json.dump(fleet_doc, f, sort_keys=True)
+                os.replace(ftmp, _fleet_path(output))
+            except OSError:
+                try:
+                    os.unlink(ftmp)
+                except OSError:
+                    pass
+            obs_fleet.import_into_recorder(view)
+            totals["fleet"] = fleet_doc
+    except Exception:
+        logger.exception("fleet observability merge failed "
+                         "(predictions are committed and unaffected)")
+
     err_parts = [p for p in
                  (_err_part_path(output, r, size) for r in range(size))
                  if os.path.exists(p)]
@@ -850,7 +899,7 @@ def _do_merge(output: str, size: int, fmt: str, entries: List[dict]) -> dict:
     # post-commit GC: the manifest FIRST — it is the merge claim, and a
     # surviving claim would outlive the fragments; everything behind it
     # is harmlessly redundant if we crash mid-loop
-    for path in [manifest] + parts + metas + err_parts:
+    for path in [manifest] + parts + metas + err_parts + obs_paths:
         try:
             os.unlink(path)
         except OSError:
@@ -1045,12 +1094,26 @@ def run_batch_predict(engine: Optional[Engine],
     writer = _Writer(out_fmt, target, _Sidecar(err_target), registry,
                      prediction_type=prediction_type)
     t0 = time.perf_counter()
+    # the whole shard run is ONE trace: a parent that spawned this
+    # process hands its context via PIO_TRACE_CONTEXT (obs/trace_context)
+    # and every shard of the fleet then shares the parent's trace id; a
+    # standalone run roots a fresh one. The completed-run record (with
+    # the read/score/write span totals) lands in the flight recorder and
+    # rides the shard's obs snapshot to the merger.
+    parent_ctx = from_env()
+    run_name = (f"batchpredict shard {rank}/{size}" if size > 1
+                else "batchpredict")
     try:
-        chunks = _iter_chunks(
-            _iter_rows(input_path, in_fmt, qc, lo, hi), chunk, registry)
-        n_chunks = _run_pipeline(chunks, scorer, writer,
-                                 cfg.queue_chunks, pipe)
-        writer.commit()
+        with carried(parent_ctx, run_name, registry=registry,
+                     attrs={"input": os.path.basename(input_path),
+                            "output": os.path.basename(output_path),
+                            "rank": rank, "size": size}) as run_trace:
+            trace_id = run_trace.trace_id
+            chunks = _iter_chunks(
+                _iter_rows(input_path, in_fmt, qc, lo, hi), chunk, registry)
+            n_chunks = _run_pipeline(chunks, scorer, writer,
+                                     cfg.queue_chunks, pipe)
+            writer.commit()
     except BaseException:
         writer.abort()
         raise
@@ -1067,10 +1130,22 @@ def run_batch_predict(engine: Optional[Engine],
         errors_path=(writer.sidecar.target if invalid else None),
         worker=(rank, size), merged=(size == 1),
         total_written=written if size == 1 else None,
-        total_invalid=invalid if size == 1 else None)
+        total_invalid=invalid if size == 1 else None,
+        trace_id=trace_id)
 
     if size > 1:
         fp = _input_fingerprint(input_path, instance)
+        # push this shard's observability to the merger: registry
+        # snapshot + this run's trace records, committed BEFORE the meta
+        # done-marker so the merging shard always finds it
+        doc = obs_fleet.snapshot(registry, process=f"{rank}/{size}",
+                                 include_traces=False,
+                                 extra={"worker": [rank, size],
+                                        "traceId": trace_id})
+        doc["traces"] = recorder().traces(trace_id=trace_id)
+        doc["events"] = [e for e in recorder().events()
+                         if e.get("traceId") == trace_id]
+        obs_fleet.write_snapshot(_obs_path(output_path, rank, size), doc)
         _write_meta(output_path, rank, size, written, invalid, fp)
         totals = _maybe_merge(output_path, size, out_fmt, fp)
         if totals is not None:
@@ -1078,6 +1153,7 @@ def run_batch_predict(engine: Optional[Engine],
             report.output_path = output_path
             report.total_written = totals["written"]
             report.total_invalid = totals["invalid"]
+            report.fleet = totals.get("fleet")
             report.errors_path = (f"{output_path}.errors.jsonl"
                                   if totals["invalid"] else None)
     logger.info(

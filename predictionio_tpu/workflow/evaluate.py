@@ -47,9 +47,18 @@ def run_evaluation(evaluation: Evaluation,
     instance.id = instance_id
     logger.info("EvaluationInstance %s created (INIT)", instance_id)
 
+    # one trace per sweep; a recurring-pipeline parent hands its context
+    # via PIO_TRACE_CONTEXT so the eval joins the pipeline's trace id
+    from predictionio_tpu.obs.trace_context import record_event
+    from predictionio_tpu.obs.tracing import adopt
+
     try:
-        with workflow_run_metrics("evaluate", "pio_eval"):
-            result = evaluation.run(ctx, engine_params_list)
+        with adopt("evaluate", attrs={"instance": instance_id}):
+            with workflow_run_metrics("evaluate", "pio_eval"):
+                result = evaluation.run(ctx, engine_params_list)
+            # recorded INSIDE the adopted trace so the completion event
+            # carries the sweep's trace id (the train.py discipline)
+            record_event("eval_completed", {"instance": instance_id})
     except Exception as e:
         # a failed sweep must not leave the instance stuck at INIT — the
         # dashboard/admin listings would show it as forever-starting

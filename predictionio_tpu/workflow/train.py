@@ -64,25 +64,35 @@ def run_train(engine: Engine,
     logger.info("EngineInstance %s created (INIT)", instance_id)
 
     blob = None
-    with workflow_run_metrics("train", "pio_train"):
-        # CoreWorkflow.runTrain:45 — train, persist, mark COMPLETED
-        result = engine.train(
-            ctx, engine_params,
-            skip_sanity_check=wp.skip_sanity_check,
-            stop_after_read=wp.stop_after_read,
-            stop_after_prepare=wp.stop_after_prepare)
+    # the whole run is one trace: a parent pipeline (or a multi-process
+    # launcher) hands its context via PIO_TRACE_CONTEXT so this train's
+    # record joins the parent's trace id in the flight recorder
+    from predictionio_tpu.obs.trace_context import record_event
+    from predictionio_tpu.obs.tracing import adopt
 
-        if wp.save_model:
-            persisted = engine.persist_models(ctx, instance_id, result)
-            blob = serialize_models(persisted)
-            Storage.get_model_data_models().insert(
-                Model(id=instance_id, models=blob))
-            logger.info("models saved (%d bytes) for instance %s",
-                        len(blob), instance_id)
+    with adopt("train", attrs={"instance": instance_id,
+                               "variant": engine_variant}):
+        with workflow_run_metrics("train", "pio_train"):
+            # CoreWorkflow.runTrain:45 — train, persist, mark COMPLETED
+            result = engine.train(
+                ctx, engine_params,
+                skip_sanity_check=wp.skip_sanity_check,
+                stop_after_read=wp.stop_after_read,
+                stop_after_prepare=wp.stop_after_prepare)
 
-        instance.status = "COMPLETED"
-        instance.end_time = _dt.datetime.now(tz=UTC)
-        instances.update(instance)
+            if wp.save_model:
+                persisted = engine.persist_models(ctx, instance_id, result)
+                blob = serialize_models(persisted)
+                Storage.get_model_data_models().insert(
+                    Model(id=instance_id, models=blob))
+                logger.info("models saved (%d bytes) for instance %s",
+                            len(blob), instance_id)
+
+            instance.status = "COMPLETED"
+            instance.end_time = _dt.datetime.now(tz=UTC)
+            instances.update(instance)
+        record_event("train_completed", {
+            "instance": instance_id, "variant": engine_variant})
 
     # register the completed instance as the variant's next release
     # (deploy/ subsystem: `pio releases` listing, warm deploys, rollback

@@ -921,3 +921,39 @@ async def test_item_fold_warms_grown_catalog(foldin_store, monkeypatch):
     assert s2["items"] == 1
     assert len(warmed) == 1                        # catalog grew: warmed
     assert warmed[0] is server._unit               # ...and then swapped
+
+
+# ---------------------------------------------------------------------------
+# SLO gating (obs/slo.py consumption: the fleet observability PR)
+# ---------------------------------------------------------------------------
+
+def test_apply_deferred_while_serving_slo_breached(foldin_store):
+    """A breached serving SLO defers fold-in applies (deltas stay
+    pending, not lost); a clear SLO lets the next tick proceed."""
+    app_id = foldin_store
+
+    class _BreachedEngine:
+        def __init__(self):
+            self.value = True
+
+        def breached(self, exclude_kinds=()):
+            return self.value
+
+    server = make_server()
+    gate = _BreachedEngine()
+    server._slo = gate
+    ctl = make_controller(server)
+    events = rate_events("newuser", ["i1", "i2", "i3"])
+    Storage.get_events().insert_batch(events, app_id)
+    ctl.offer(events)
+    assert ctl.pending_rows() > 0
+
+    assert ctl.apply_pending() is None
+    assert counter_value(ctl._m_applies, outcome="deferred") == 1
+    assert ctl.pending_rows() > 0          # nothing lost
+
+    gate.value = False                     # SLO clear: the apply runs
+    stats = ctl.apply_pending()
+    assert stats is not None and stats["users"] == 1
+    assert counter_value(ctl._m_applies, outcome="applied") == 1
+    assert ctl.pending_rows() == 0

@@ -1,0 +1,246 @@
+"""Fleet metric aggregation (obs/fleet.py) + the sharded-batchpredict
+acceptance bar: a 2-process run yields ONE merged view whose fleet
+counters equal the sum of per-shard counters, and one trace id spans
+the parent and both shards in the flight recorder."""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import fleet, trace_context as tc
+from predictionio_tpu.obs.registry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    tc.recorder().clear()
+    yield
+    tc.recorder().clear()
+
+
+# ---------------------------------------------------------------------------
+# snapshot files + FleetView
+# ---------------------------------------------------------------------------
+
+def _shard_registry(n_queries, lat=0.01):
+    r = MetricsRegistry()
+    c = r.counter("pio_batchpredict_queries_total", "q")
+    c.inc(n_queries)
+    h = r.histogram("pio_span_duration_seconds", "s", labelnames=("span",),
+                    buckets=(0.001, 0.01, 0.1))
+    for _ in range(3):
+        h.observe(lat, span="batchpredict_score")
+    return r
+
+
+def test_snapshot_roundtrip_and_crash_safe_commit(tmp_path):
+    reg = _shard_registry(5)
+    doc = fleet.snapshot(reg, process="0/2", include_traces=False)
+    path = str(tmp_path / "s.obs.json")
+    fleet.write_snapshot(path, doc)
+    back = fleet.read_snapshot(path)
+    assert back["process"] == "0/2"
+    assert back["metrics"]["pio_batchpredict_queries_total"][
+        "series"][0]["value"] == 5
+    # torn/garbage files read as None, never raise
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert fleet.read_snapshot(str(bad)) is None
+    assert fleet.read_snapshot(str(tmp_path / "missing.json")) is None
+
+
+def test_fleet_view_sums_counters_exactly():
+    view = fleet.FleetView()
+    view.add(fleet.snapshot(_shard_registry(7), process="0/2",
+                            include_traces=False))
+    view.add(fleet.snapshot(_shard_registry(5), process="1/2",
+                            include_traces=False))
+    assert view.counter_total("pio_batchpredict_queries_total") == 12
+    assert view.counter_totals()["pio_batchpredict_queries_total"] == 12
+    # per-process series survive under the process label
+    metric = view.registry.get("pio_batchpredict_queries_total")
+    per = {s[0]["process"]: s[1] for s in metric.samples()}
+    assert per == {"0/2": 7.0, "1/2": 5.0}
+    # histogram merge: exact bucket sums across the fleet
+    h = view.registry.get("pio_span_duration_seconds")
+    assert h.total_count() == 6
+
+
+def test_fleet_view_collects_and_dedupes_traces():
+    view = fleet.FleetView()
+    span = {"traceId": "T", "spanId": "s1", "name": "shard 0/2",
+            "durationSec": 0.5}
+    doc0 = {"process": "0/2", "metrics": {}, "traces": [span], "events": []}
+    # shard 1's ring (same in-process recorder) re-exports shard 0's span
+    doc1 = {"process": "1/2", "metrics": {},
+            "traces": [span, {"traceId": "T", "spanId": "s2",
+                              "name": "shard 1/2", "durationSec": 0.4}],
+            "events": []}
+    view.add(doc0)
+    view.add(doc1)
+    assert len(view.traces("T")) == 2
+    assert view.trace_ids() == ["T"]
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance test: 2-shard batchpredict -> one merged fleet view
+# ---------------------------------------------------------------------------
+
+def _synth_result(nu=40, ni=24, rank=4, seed=5):
+    from predictionio_tpu.core.engine import TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, RecommendationServing,
+    )
+    from predictionio_tpu.models.als import ALSModel
+
+    rng = np.random.default_rng(seed)
+    model = ALSModel(
+        user_vocab=np.asarray([f"u{i}" for i in range(nu)], dtype=object),
+        item_vocab=np.asarray([f"i{i}" for i in range(ni)], dtype=object),
+        U=rng.normal(size=(nu, rank)).astype(np.float32),
+        V=rng.normal(size=(ni, rank)).astype(np.float32))
+    return TrainResult(
+        models=[model], algorithms=[ALSAlgorithm(AlgorithmParams())],
+        serving=RecommendationServing(), engine_params=EngineParams())
+
+
+def test_two_shard_fleet_metrics_and_one_trace(tmp_path, monkeypatch):
+    """The PR's acceptance criterion end to end: each shard runs with its
+    OWN registry (as separate processes would), pushes its obs snapshot
+    next to its fragment, and the merging shard produces one fleet view
+    whose counters equal the sum of the per-shard counters — with ONE
+    trace id (the parent's, via PIO_TRACE_CONTEXT) spanning both shards
+    in the flight recorder."""
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    result = _synth_result()
+    inp = tmp_path / "q.jsonl"
+    n = 60
+    with open(inp, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({"user": f"u{i % 40}", "num": 3}) + "\n")
+
+    parent = tc.TraceContext.root()
+    monkeypatch.setenv(tc.TRACE_ENV, parent.encode())
+    out = tmp_path / "preds.jsonl"
+    regs = [MetricsRegistry(), MetricsRegistry()]
+    reports = []
+    for rank in (0, 1):
+        reports.append(run_batch_predict(
+            None, None, str(inp), str(out), chunk_size=16,
+            loaded=(result, None), worker=(rank, 2),
+            registry=regs[rank]))
+
+    assert reports[1].merged and reports[1].total_written == n
+    # both shards rode the parent's trace id
+    assert reports[0].trace_id == parent.trace_id
+    assert reports[1].trace_id == parent.trace_id
+
+    fleet_doc = reports[1].fleet
+    assert fleet_doc is not None
+    assert sorted(fleet_doc["processes"]) == ["0/2", "1/2"]
+
+    # fleet counters == sum of per-shard counters, exactly
+    shard_total = sum(
+        reg.get("pio_batchpredict_queries_total").value() for reg in regs)
+    assert shard_total == n
+    assert fleet_doc["counterTotals"][
+        "pio_batchpredict_queries_total"] == shard_total
+    per_process = {
+        s["labels"]["process"]: s["value"]
+        for s in fleet_doc["metrics"]["pio_batchpredict_queries_total"]
+        ["samples"]}
+    assert per_process == {
+        "0/2": reports[0].written, "1/2": reports[1].written}
+
+    # ONE trace id spans parent + both shards in the merged records
+    spans = [t for t in fleet_doc["traces"]
+             if t["traceId"] == parent.trace_id]
+    names = {t["name"] for t in spans}
+    assert names == {"batchpredict shard 0/2", "batchpredict shard 1/2"}
+
+    # ... and the merger imported them into ITS flight recorder
+    local = tc.recorder().traces(parent.trace_id)
+    assert {t["name"] for t in local} >= names
+
+    # the committed artifact survives the merge GC; obs fragments do not
+    assert (tmp_path / "preds.jsonl.fleet.json").exists()
+    leftovers = [p.name for p in tmp_path.iterdir() if ".obs-" in p.name]
+    assert not leftovers, leftovers
+
+
+def test_fleet_cli_status_view(tmp_path, monkeypatch):
+    """`pio status --fleet <output>` renders the merged view."""
+    from click.testing import CliRunner
+
+    from predictionio_tpu.cli.main import cli
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    result = _synth_result()
+    inp = tmp_path / "q.jsonl"
+    with open(inp, "w") as f:
+        for i in range(20):
+            f.write(json.dumps({"user": f"u{i % 40}", "num": 3}) + "\n")
+    monkeypatch.delenv(tc.TRACE_ENV, raising=False)
+    out = tmp_path / "preds.jsonl"
+    for rank in (0, 1):
+        run_batch_predict(None, None, str(inp), str(out), chunk_size=8,
+                          loaded=(result, None), worker=(rank, 2),
+                          registry=MetricsRegistry())
+    res = CliRunner().invoke(cli, ["status", "--fleet", str(out)])
+    assert res.exit_code == 0, res.output
+    assert "pio_batchpredict_queries_total fleet total: 20" in res.output
+    assert "process 0/2" in res.output and "process 1/2" in res.output
+    assert "trace " in res.output
+
+
+def test_single_process_run_has_no_fleet_artifacts(tmp_path, monkeypatch):
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    monkeypatch.delenv(tc.TRACE_ENV, raising=False)
+    inp = tmp_path / "q.jsonl"
+    with open(inp, "w") as f:
+        f.write(json.dumps({"user": "u1", "num": 3}) + "\n")
+    rep = run_batch_predict(None, None, str(inp),
+                            str(tmp_path / "o.jsonl"), chunk_size=8,
+                            loaded=(_synth_result(), None))
+    assert rep.fleet is None
+    assert not list(tmp_path.glob("*.fleet.json"))
+
+
+# ---------------------------------------------------------------------------
+# dispatch attribution (obs/profiler.py via ops/fn_cache.py)
+# ---------------------------------------------------------------------------
+
+def test_fn_cache_dispatch_attribution():
+    from predictionio_tpu.obs.profiler import dispatch_counter, dispatch_table
+    from predictionio_tpu.ops.fn_cache import shape_cached_fn
+
+    counter = dispatch_counter()
+    before = counter.value(family="attr_test")
+    fn = shape_cached_fn("attr_test", ("k", 1), lambda: (lambda x: x + 1))
+    assert fn(1) == 2 and fn(2) == 3
+    assert counter.value(family="attr_test") > before
+    assert "attr_test" in dispatch_table()
+
+
+def test_fn_cache_attribution_disabled(monkeypatch):
+    from predictionio_tpu.obs import profiler
+    from predictionio_tpu.ops.fn_cache import shape_cached_fn
+
+    monkeypatch.setenv(profiler.DISPATCH_ENV, "0")
+    fn = shape_cached_fn("attr_off", ("k", 1), lambda: (lambda x: x * 2))
+    assert fn(4) == 8
+    table = profiler.dispatch_table()
+    assert "attr_off" not in table
+
+
+def test_profiler_capture_is_bounded_and_exclusive(tmp_path):
+    from predictionio_tpu.obs import profiler
+
+    out = profiler.capture(0.05, str(tmp_path / "prof"))
+    assert out["seconds"] >= 0.05
+    assert out["traceDir"].endswith("prof")
+    assert isinstance(out["dispatch"], dict)

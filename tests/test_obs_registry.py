@@ -223,3 +223,233 @@ def test_render_json_histogram_summaries():
 
 def test_default_registry_is_process_wide_singleton():
     assert default_registry() is default_registry()
+
+
+# -- label-cardinality guard (fleet observability PR satellite) --------------
+
+def test_cardinality_guard_buckets_overflow_as_other():
+    r = MetricsRegistry()
+    c = r.counter("pio_guard_total", "g", labelnames=("entity",),
+                  max_series=3)
+    for i in range(10):
+        c.inc(entity=f"e{i}")
+    labels = {s[0]["entity"] for s in c.samples()}
+    assert labels == {"e0", "e1", "e2", "other"}
+    assert c.value(entity="other") == 7
+    # total volume is preserved, only attribution collapses
+    assert sum(v for _, v in c.samples()) == 10
+    overflow = r.get("pio_obs_label_overflow_total")
+    assert overflow.value(metric="pio_guard_total") == 7
+
+
+def test_cardinality_guard_existing_series_keep_counting():
+    r = MetricsRegistry()
+    c = r.counter("pio_guard_total", "g", labelnames=("k",), max_series=2)
+    c.inc(k="a")
+    c.inc(k="b")
+    c.inc(k="c")          # overflows
+    c.inc(k="a")          # existing series unaffected by the cap
+    assert c.value(k="a") == 2
+    assert c.value(k="other") == 1
+
+
+def test_cardinality_guard_histogram_and_gauge():
+    r = MetricsRegistry()
+    h = r.histogram("pio_guard_seconds", "g", labelnames=("q",),
+                    buckets=(1.0, 2.0), max_series=2)
+    for i in range(5):
+        h.observe(0.5, q=f"q{i}")
+    assert h.count(q="other") == 3
+    g = r.gauge("pio_guard_gauge", "g", labelnames=("q",), max_series=2)
+    for i in range(5):
+        g.set(float(i), q=f"q{i}")
+    assert g.value(q="other") == 4.0      # last overflow write wins
+    overflow = r.get("pio_obs_label_overflow_total")
+    assert overflow.value(metric="pio_guard_seconds") == 3
+    assert overflow.value(metric="pio_guard_gauge") == 3
+
+
+def test_unlabelled_metrics_ignore_the_guard():
+    r = MetricsRegistry()
+    c = r.counter("pio_plain_total", "p", max_series=1)
+    for _ in range(5):
+        c.inc()
+    assert c.value() == 5
+    assert r.get("pio_obs_label_overflow_total") is None
+
+
+# -- concurrent scrape during heavy mutation (PR satellite) ------------------
+
+def test_concurrent_scrape_during_heavy_mutation():
+    """Scrapes racing writers must neither raise nor produce torn
+    exposition: every rendered snapshot parses, and the final totals are
+    exact."""
+    r = MetricsRegistry()
+    c = r.counter("pio_mut_total", "m", labelnames=("w",))
+    h = r.histogram("pio_mut_seconds", "m", labelnames=("w",),
+                    buckets=(0.001, 0.01, 0.1, 1.0))
+    g = r.gauge("pio_mut_gauge", "m")
+    stop = threading.Event()
+    errors = []
+
+    def writer(w):
+        try:
+            i = 0
+            while not stop.is_set():
+                c.inc(w=str(w))
+                h.observe((i % 7) / 10.0, w=str(w))
+                g.set(float(i))
+                i += 1
+        except Exception as e:            # pragma: no cover
+            errors.append(e)
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                text = render_prometheus([r])
+                parse_exposition(text)    # asserts well-formed lines
+                json.dumps(r.render_json())
+                r.to_snapshot()
+        except Exception as e:            # pragma: no cover
+            errors.append(e)
+
+    threads = ([threading.Thread(target=writer, args=(w,))
+                for w in range(4)]
+               + [threading.Thread(target=scraper) for _ in range(2)])
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    # post-race consistency: per-writer counter == histogram count
+    for w in range(4):
+        assert c.value(w=str(w)) == h.count(w=str(w))
+
+
+# -- quantile accuracy at exponential bucket edges (PR satellite) ------------
+
+def test_quantile_accuracy_at_exponential_bucket_edges():
+    """Observations placed EXACTLY on exponential bucket bounds must
+    estimate quantiles inside the bucket that holds them (bisect_left:
+    an observation equal to a bound belongs to that bound's bucket), so
+    the estimate never exceeds the true value's bound nor falls below
+    the previous bound."""
+    buckets = exponential_buckets(0.001, 2.0, 12)
+    h = Histogram("pio_edge_seconds", buckets=buckets)
+    for b in buckets:
+        for _ in range(10):
+            h.observe(b)
+    import math
+
+    n = len(buckets)
+    for q in (0.25, 0.5, 0.75, 0.9, 0.99):
+        est = h.quantile(q)
+        # the observation at the q-quantile rank sits exactly on a bound
+        true_idx = min(n - 1, (int(math.ceil(q * n * 10)) - 1) // 10)
+        lower = buckets[true_idx - 1] if true_idx > 0 else 0.0
+        assert lower <= est <= buckets[true_idx], (
+            q, est, lower, buckets[true_idx])
+    # an exact-bound observation is counted at ITS bound, not the next
+    assert h.count_below(buckets[0]) == 10
+    assert h.count_below(buckets[1]) == 20
+
+
+def test_count_below_matches_bucket_boundaries():
+    h = Histogram("pio_cb_seconds", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 2.0, 3.0, 9.0):
+        h.observe(v)
+    assert h.count_below(1.0) == 2      # 0.5, 1.0
+    assert h.count_below(2.0) == 4      # + 1.5, 2.0
+    assert h.count_below(4.0) == 5      # + 3.0
+    assert h.count_below(100.0) == 6    # everything incl. +Inf bucket
+
+
+# -- snapshot/merge algebra (PR satellite) -----------------------------------
+
+def _registry_a():
+    r = MetricsRegistry()
+    c = r.counter("pio_alg_total", "a", labelnames=("k",))
+    c.inc(3, k="x")
+    c.inc(1, k="y")
+    h = r.histogram("pio_alg_seconds", "a", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 9.0):
+        h.observe(v)
+    return r
+
+
+def _registry_b():
+    r = MetricsRegistry()
+    c = r.counter("pio_alg_total", "b", labelnames=("k",))
+    c.inc(7, k="x")
+    c.inc(2, k="z")
+    h = r.histogram("pio_alg_seconds", "b", buckets=(1.0, 2.0, 4.0))
+    for v in (1.0, 1.0, 2.5):
+        h.observe(v)
+    return r
+
+
+def test_merge_is_commutative_and_exact():
+    a = _registry_a().to_snapshot()
+    b = _registry_b().to_snapshot()
+    ab, ba = MetricsRegistry(), MetricsRegistry()
+    ab.merge_snapshot(a)
+    ab.merge_snapshot(b)
+    ba.merge_snapshot(b)
+    ba.merge_snapshot(a)
+    assert parse_exposition(render_prometheus([ab])) == \
+        parse_exposition(render_prometheus([ba]))
+    assert ab.get("pio_alg_total").value(k="x") == 10
+    # histogram merge is exact per-bucket addition, not re-estimation
+    h = ab.get("pio_alg_seconds")
+    assert h.total_count() == 7
+    assert h.count_below(1.0) == 3      # 0.5 + two 1.0s
+    assert h.total_sum() == pytest.approx(0.5 + 1.5 + 3.0 + 9.0
+                                          + 1.0 + 1.0 + 2.5)
+
+
+def test_merge_with_empty_is_identity():
+    a = _registry_a().to_snapshot()
+    merged, plain = MetricsRegistry(), MetricsRegistry()
+    merged.merge_snapshot(a)
+    merged.merge_snapshot(MetricsRegistry().to_snapshot())
+    plain.merge_snapshot(a)
+    assert parse_exposition(render_prometheus([merged])) == \
+        parse_exposition(render_prometheus([plain]))
+
+
+def test_merge_is_associative():
+    a = _registry_a().to_snapshot()
+    b = _registry_b().to_snapshot()
+    c_reg = MetricsRegistry()
+    c_reg.counter("pio_alg_total", "c", labelnames=("k",)).inc(5, k="y")
+    c = c_reg.to_snapshot()
+    left, right = MetricsRegistry(), MetricsRegistry()
+    # (a + b) + c
+    tmp = MetricsRegistry()
+    tmp.merge_snapshot(a)
+    tmp.merge_snapshot(b)
+    left.merge_snapshot(tmp.to_snapshot())
+    left.merge_snapshot(c)
+    # a + (b + c)
+    tmp2 = MetricsRegistry()
+    tmp2.merge_snapshot(b)
+    tmp2.merge_snapshot(c)
+    right.merge_snapshot(a)
+    right.merge_snapshot(tmp2.to_snapshot())
+    assert parse_exposition(render_prometheus([left])) == \
+        parse_exposition(render_prometheus([right]))
+
+
+def test_merge_rejects_mismatched_histogram_buckets():
+    a = MetricsRegistry()
+    a.histogram("pio_mm_seconds", "m", buckets=(1.0, 2.0)).observe(0.5)
+    b = MetricsRegistry()
+    b.histogram("pio_mm_seconds", "m", buckets=(1.0, 4.0)).observe(0.5)
+    target = MetricsRegistry()
+    target.merge_snapshot(a.to_snapshot())
+    with pytest.raises(ValueError):
+        target.merge_snapshot(b.to_snapshot())

@@ -1,0 +1,361 @@
+"""SLO burn-rate engine (obs/slo.py): spec parsing, windowed burn math,
+the canary judgment parity (the controller now delegates here with no
+behavior change), and the /slo.json breach-flip e2e."""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import slo as slo_mod
+from predictionio_tpu.obs import trace_context as tc
+from predictionio_tpu.obs.registry import MetricsRegistry
+from predictionio_tpu.obs.slo import (
+    SLOEngine, SLOObjective, SLOSpec, SLOWindow, SlidingStats,
+    judge_relative,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    tc.recorder().clear()
+    yield
+    tc.recorder().clear()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+
+def test_spec_from_dict_defaults_and_values():
+    spec = SLOSpec.from_dict({
+        "objectives": [
+            {"name": "p99", "kind": "latency", "thresholdS": 0.256,
+             "budget": 0.01},
+            {"kind": "errors"},
+        ],
+        "windows": [{"seconds": 60, "burnThreshold": 3.5}],
+        "evalIntervalS": 2.0,
+    })
+    assert [o.name for o in spec.objectives] == ["p99", "errors"]
+    assert spec.objectives[0].threshold_s == 0.256
+    assert spec.objectives[1].budget == 0.01
+    assert spec.windows[0].burn_threshold == 3.5
+    assert spec.eval_interval_s == 2.0
+    # no windows section -> the SRE-workbook defaults
+    spec2 = SLOSpec.from_dict({"objectives": [{"kind": "errors"}]})
+    assert [(w.seconds, w.burn_threshold) for w in spec2.windows] == \
+        list(slo_mod.DEFAULT_WINDOWS)
+
+
+def test_spec_from_dict_rejects_malformed():
+    assert SLOSpec.from_dict(None) is None
+    assert SLOSpec.from_dict({}) is None
+    assert SLOSpec.from_dict({"objectives": []}) is None
+    with pytest.raises(ValueError):
+        SLOSpec.from_dict({"objectives": [{"kind": "nonsense"}]})
+    with pytest.raises(ValueError):
+        # latency without a threshold is meaningless
+        SLOSpec.from_dict({"objectives": [{"kind": "latency"}]})
+    with pytest.raises(ValueError):
+        SLOSpec.from_dict({"objectives": [{"kind": "errors",
+                                           "budget": 0}]})
+
+
+def test_spec_from_server_json(tmp_path, monkeypatch):
+    conf = tmp_path / "server.json"
+    conf.write_text(json.dumps({
+        "slo": {"objectives": [{"kind": "errors", "budget": 0.05}]}}))
+    monkeypatch.setenv("PIO_SERVER_CONF", str(conf))
+    spec = slo_mod.slo_spec_from_server_json()
+    assert spec is not None and spec.objectives[0].budget == 0.05
+    monkeypatch.setenv(slo_mod.SLO_ENV, "0")
+    assert slo_mod.slo_spec_from_server_json() is None
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math with injected sources
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_multi_window_breach_and_clear():
+    vals = {"bad": 0.0, "total": 0.0}
+    reg = MetricsRegistry()
+    spec = SLOSpec(
+        objectives=[SLOObjective("errs", "errors", budget=0.1)],
+        windows=[SLOWindow(10.0, 5.0), SLOWindow(100.0, 1.0)],
+        eval_interval_s=5.0)
+    eng = SLOEngine(reg, spec,
+                    sources={"errors": lambda obj: (vals["bad"],
+                                                    vals["total"])})
+    # 100s of healthy traffic: 1% errors = burn 0.1 on both windows
+    t = 0.0
+    while t <= 100.0:
+        vals["total"] += 50
+        vals["bad"] += 0.5
+        status = eng.tick(now=t)
+        t += 5.0
+    assert status["breached"] is False
+    assert not eng.breached()
+
+    # errors spike to 100%: the SHORT window burns immediately, but the
+    # long window still mostly remembers the healthy traffic -> the
+    # multi-window AND holds the page
+    vals["total"] += 50
+    vals["bad"] += 50
+    status = eng.tick(now=t)
+    short, long_ = status["objectives"][0]["windows"]
+    assert short["burn"] >= 5.0
+    assert status["objectives"][0]["breached"] is False
+
+    # sustained burn: once the long window is saturated too, it flips
+    while t <= 205.0:
+        t += 5.0
+        vals["total"] += 50
+        vals["bad"] += 50
+        status = eng.tick(now=t)
+    assert status["objectives"][0]["breached"] is True
+    assert eng.breached()
+    assert reg.get("pio_slo_breach_total").value(objective="errs") == 1
+    assert reg.get("pio_slo_breached").value(objective="errs") == 1.0
+    assert tc.recorder().events()[-1]["kind"] == "slo_breach"
+
+    # recovery: healthy traffic drains both windows, state clears, and
+    # the transition counter does NOT double-count
+    while t <= 420.0:
+        t += 5.0
+        vals["total"] += 50
+        vals["bad"] += 0.0
+        status = eng.tick(now=t)
+    assert status["objectives"][0]["breached"] is False
+    assert not eng.breached()
+    assert reg.get("pio_slo_breach_total").value(objective="errs") == 1
+
+
+def test_burn_rate_no_traffic_is_not_a_breach():
+    reg = MetricsRegistry()
+    spec = SLOSpec(objectives=[SLOObjective("errs", "errors", budget=0.01)],
+                   windows=[SLOWindow(10.0, 1.0)], eval_interval_s=1.0)
+    eng = SLOEngine(reg, spec, sources={"errors": lambda obj: (0.0, 0.0)})
+    for t in range(5):
+        status = eng.tick(now=float(t))
+    assert status["breached"] is False
+
+
+def test_latency_source_reads_histogram_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("pio_query_duration_seconds", "q",
+                      labelnames=("engine_variant",),
+                      buckets=(0.1, 0.2, 0.4))
+    for v in (0.05, 0.15, 0.3, 0.9):
+        h.observe(v, engine_variant="default")
+    spec = SLOSpec(objectives=[SLOObjective("lat", "latency",
+                                            threshold_s=0.2, budget=0.5)],
+                   windows=[SLOWindow(60.0, 1.0)])
+    eng = SLOEngine(reg, spec)
+    bad, total = eng._cumulative(spec.objectives[0])
+    assert total == 4 and bad == 2          # 0.3 and 0.9 are above 0.2
+
+
+# ---------------------------------------------------------------------------
+# canary judgment parity: the controller delegates with no behavior change
+# ---------------------------------------------------------------------------
+
+def _controller(**kw):
+    from predictionio_tpu.deploy.canary import CanaryConfig, CanaryController
+
+    return CanaryController(CanaryConfig(**kw))
+
+
+def _replay(observations, **cfg_kw):
+    """Drive BOTH the canary controller and a direct judge_relative
+    replay with the same observation stream; return (controller verdict,
+    direct verdict). They must agree at every step."""
+    from predictionio_tpu.deploy.canary import (
+        ROLE_CANARY, ROLE_INCUMBENT,
+    )
+
+    ctl = _controller(**cfg_kw)
+    cfg = ctl.config
+    inc, can = SlidingStats(cfg.window), SlidingStats(cfg.window)
+    direct_verdict = None
+    ctl_verdict = None
+    for role, seconds, ok in observations:
+        v = ctl.observe(role, seconds, ok)
+        if v is not None and ctl_verdict is None:
+            ctl_verdict = v
+        (inc if role == ROLE_INCUMBENT else can).observe(seconds, ok)
+        if direct_verdict is None:
+            direct_verdict = judge_relative(
+                inc, can, min_samples=cfg.min_samples,
+                error_rate_slack=cfg.error_rate_slack,
+                p99_ratio=cfg.p99_ratio,
+                latency_slack_s=cfg.latency_slack_s,
+                promote_after=cfg.promote_after)
+    return ctl_verdict, direct_verdict
+
+
+def test_judge_parity_error_rollback():
+    from predictionio_tpu.deploy.canary import ROLE_CANARY, ROLE_INCUMBENT
+
+    obs = []
+    for i in range(30):
+        obs.append((ROLE_INCUMBENT, 0.01, True))
+        obs.append((ROLE_CANARY, 0.01, i % 2 == 0))   # 50% errors
+    ctl_v, direct_v = _replay(obs, fraction=0.5, window=50, min_samples=10,
+                              promote_after=40)
+    assert ctl_v == direct_v
+    assert ctl_v[0] == "rollback" and ctl_v[1].startswith("slo_errors")
+
+
+def test_judge_parity_latency_rollback():
+    from predictionio_tpu.deploy.canary import ROLE_CANARY, ROLE_INCUMBENT
+
+    obs = []
+    for _ in range(30):
+        obs.append((ROLE_INCUMBENT, 0.010, True))
+        obs.append((ROLE_CANARY, 0.500, True))        # 50x slower
+    ctl_v, direct_v = _replay(obs, fraction=0.5, window=50, min_samples=10,
+                              promote_after=40)
+    assert ctl_v == direct_v
+    assert ctl_v[0] == "rollback" and ctl_v[1].startswith("slo_latency")
+
+
+def test_judge_parity_healthy_promote():
+    from predictionio_tpu.deploy.canary import ROLE_CANARY, ROLE_INCUMBENT
+
+    obs = []
+    for _ in range(60):
+        obs.append((ROLE_INCUMBENT, 0.01, True))
+        obs.append((ROLE_CANARY, 0.011, True))
+    ctl_v, direct_v = _replay(obs, fraction=0.5, window=50, min_samples=10,
+                              promote_after=40)
+    assert ctl_v == direct_v == ("promote", "healthy: SLO window clean")
+
+
+def test_judge_parity_insufficient_samples():
+    from predictionio_tpu.deploy.canary import ROLE_CANARY, ROLE_INCUMBENT
+
+    obs = [(ROLE_INCUMBENT, 0.01, True), (ROLE_CANARY, 9.0, False)] * 3
+    ctl_v, direct_v = _replay(obs, fraction=0.5, window=50, min_samples=10,
+                              promote_after=40)
+    assert ctl_v is None and direct_v is None
+
+
+def test_sliding_stats_reexport_is_the_slo_class():
+    import predictionio_tpu.deploy.canary as canary_mod
+
+    assert canary_mod.SlidingStats is SlidingStats
+
+
+# ---------------------------------------------------------------------------
+# e2e: a configured burn-rate breach flips /slo.json within one window
+# ---------------------------------------------------------------------------
+
+def _hermetic_server(slo_spec):
+    from predictionio_tpu.core.engine import Engine, TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, RecommendationServing,
+    )
+    from predictionio_tpu.models.als import ALSModel
+    from predictionio_tpu.server.query_server import create_query_server
+    from predictionio_tpu.storage.base import EngineInstance
+    from predictionio_tpu.utils.server_config import ServingConfig
+
+    rng = np.random.default_rng(7)
+    nu, ni, rank = 30, 20, 4
+    model = ALSModel(
+        user_vocab=np.asarray([f"u{i}" for i in range(nu)], dtype=object),
+        item_vocab=np.asarray([f"i{i}" for i in range(ni)], dtype=object),
+        U=rng.normal(size=(nu, rank)).astype(np.float32),
+        V=rng.normal(size=(ni, rank)).astype(np.float32))
+    result = TrainResult(
+        models=[model], algorithms=[ALSAlgorithm(AlgorithmParams())],
+        serving=RecommendationServing(), engine_params=EngineParams())
+    instance = EngineInstance(id="slo-e2e", engine_id="bench",
+                              engine_variant="default")
+    return create_query_server(
+        Engine({}, {}, {"als": ALSAlgorithm}, {}), result, instance, None,
+        serving_config=ServingConfig(batch_max=8, batch_linger_s=0.0),
+        slo_spec=slo_spec)
+
+
+async def test_breach_flips_slo_json_within_one_window():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    spec = SLOSpec(
+        objectives=[SLOObjective("errors", "errors", budget=0.05)],
+        windows=[SLOWindow(60.0, 2.0)],
+        eval_interval_s=0.1)
+    server = _hermetic_server(spec)
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        # healthy traffic
+        for i in range(10):
+            r = await c.post("/queries.json",
+                             json={"user": f"u{i % 30}", "num": 3})
+            assert r.status == 200
+        r = await c.get("/slo.json")
+        body = await r.json()
+        assert body["enabled"] is True
+        assert body["breached"] is False
+
+        # a burst of failing requests (bad JSON -> pio_query_failures)
+        for _ in range(30):
+            r = await c.post("/queries.json", data=b"{not json")
+            assert r.status == 400
+        # the next evaluation (an on-demand read ticks the engine) must
+        # show the breach — within one evaluation window by construction
+        r = await c.get("/slo.json")
+        body = await r.json()
+        assert body["breached"] is True
+        errs = body["objectives"][0]
+        assert errs["breached"] and errs["windows"][0]["burn"] >= 2.0
+        # burn gauges + transition counter + flight-recorder event
+        assert server.registry.get("pio_slo_breach_total").value(
+            objective="errors") == 1
+        kinds = [e["kind"] for e in tc.recorder().events()]
+        assert "slo_breach" in kinds
+    finally:
+        await c.close()
+
+
+async def test_slo_json_disabled_without_spec():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    server = _hermetic_server(None)
+    c = TestClient(TestServer(server.app))
+    await c.start_server()
+    try:
+        r = await c.get("/slo.json")
+        body = await r.json()
+        assert body["enabled"] is False
+    finally:
+        await c.close()
+
+
+def test_breached_exclude_kinds():
+    """Fold-in gating consumes breached(exclude_kinds=("freshness",)):
+    a freshness-only breach must not defer the applies that fix it."""
+    vals = {"bad": 0.0, "total": 0.0}
+    reg = MetricsRegistry()
+    spec = SLOSpec(
+        objectives=[
+            SLOObjective("fresh", "freshness", threshold_s=1.0,
+                         budget=0.1),
+            SLOObjective("errs", "errors", budget=0.1)],
+        windows=[SLOWindow(10.0, 1.0)], eval_interval_s=1.0)
+    eng = SLOEngine(
+        reg, spec,
+        sources={
+            "freshness": lambda obj: (vals["bad"], vals["total"]),
+            "errors": lambda obj: (0.0, vals["total"])})
+    eng.tick(now=0.0)
+    vals["bad"] += 50
+    vals["total"] += 50
+    eng.tick(now=5.0)
+    assert eng.breached() is True
+    assert eng.breached(exclude_kinds=("freshness",)) is False

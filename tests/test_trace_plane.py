@@ -1,0 +1,372 @@
+"""Cross-process trace propagation + the flight recorder
+(obs/trace_context.py, obs/tracing.py, and the thread/process hops the
+fleet observability PR closed: WriteBuffer flush, MicroBatcher executor,
+batchpredict shards)."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import trace_context as tc
+from predictionio_tpu.obs import tracing
+from predictionio_tpu.obs.registry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    tc.recorder().clear()
+    yield
+    tc.recorder().clear()
+
+
+# ---------------------------------------------------------------------------
+# TraceContext wire format
+# ---------------------------------------------------------------------------
+
+def test_context_encode_decode_roundtrip():
+    ctx = tc.TraceContext.root()
+    assert tc.TraceContext.decode(ctx.encode()) == ctx
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+
+
+@pytest.mark.parametrize("raw", [
+    None, "", "justone", "a:b:c", ":", "a:", ":b", "bad id:x", "a!:b",
+])
+def test_context_decode_rejects_malformed(raw):
+    assert tc.TraceContext.decode(raw) is None
+
+
+def test_env_roundtrip(monkeypatch):
+    ctx = tc.TraceContext.root()
+    env = tc.child_env(ctx, base={})
+    assert tc.TRACE_ENV in env
+    got = tc.TraceContext.decode(env[tc.TRACE_ENV])
+    assert got.trace_id == ctx.trace_id          # same trace ...
+    assert got.span_id != ctx.span_id            # ... new hop span
+    monkeypatch.setenv(tc.TRACE_ENV, env[tc.TRACE_ENV])
+    assert tc.from_env().trace_id == ctx.trace_id
+    monkeypatch.delenv(tc.TRACE_ENV)
+    assert tc.from_env() is None
+
+
+def test_worker_env_carries_shard_contract_and_trace():
+    from predictionio_tpu.parallel.distributed import worker_env
+
+    ctx = tc.TraceContext.root()
+    env = worker_env(1, 4, base={}, trace_context=ctx)
+    assert env["PIO_PROCESS_ID"] == "1"
+    assert env["PIO_NUM_PROCESSES"] == "4"
+    assert tc.TraceContext.decode(env[tc.TRACE_ENV]).trace_id == ctx.trace_id
+    with pytest.raises(ValueError):
+        worker_env(4, 4, base={})
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_recorder_rings_are_bounded():
+    rec = tc.FlightRecorder(capacity=8, event_capacity=4)
+    for i in range(50):
+        rec.record_span(trace_id=f"t{i}", span_id=f"s{i}",
+                        parent_span_id=None, name="x", duration_s=0.01)
+        rec.record_event("swap", {"i": i})
+    assert len(rec.traces()) == 8
+    assert len(rec.events()) == 4
+    assert rec.traces()[-1]["traceId"] == "t49"
+
+
+def test_recorder_filter_and_import():
+    rec = tc.FlightRecorder()
+    rec.record_span(trace_id="a", span_id="1", parent_span_id=None,
+                    name="x", duration_s=0.1)
+    rec.record_span(trace_id="b", span_id="2", parent_span_id=None,
+                    name="y", duration_s=0.1)
+    assert [t["traceId"] for t in rec.traces("a")] == ["a"]
+    other = tc.FlightRecorder()
+    # records keep their own process label; the fallback only fills gaps
+    bare = [{k: v for k, v in t.items() if k != "process"}
+            for t in rec.traces()]
+    other.import_records(bare, [], process="7/8")
+    assert {t["process"] for t in other.traces()} == {"7/8"}
+    own = rec.traces()[0]
+    other.import_records([own], [], process="9/9")
+    assert other.traces()[-1]["process"] == own["process"]
+
+
+def test_record_event_stamps_active_trace():
+    tokens, trace = tracing.start_trace("rid-1")
+    try:
+        rec = tc.record_event("swap", {"mode": "warm"})
+    finally:
+        tracing.reset_trace(tokens)
+    assert rec["traceId"] == trace.trace_id
+    assert tc.recorder().events()[-1]["kind"] == "swap"
+
+
+# ---------------------------------------------------------------------------
+# thread hops: carried()
+# ---------------------------------------------------------------------------
+
+def test_carried_links_worker_thread_to_submitting_trace():
+    import threading
+
+    tokens, trace = tracing.start_trace("req-9")
+    ctx = tracing.capture_context()
+    tracing.reset_trace(tokens)
+    assert ctx.trace_id == trace.trace_id
+
+    seen = {}
+
+    def worker():
+        with tracing.carried(ctx, "flush-hop") as t:
+            with tracing.span("inner"):
+                pass
+            seen["trace_id"] = t.trace_id
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    assert seen["trace_id"] == trace.trace_id
+    rec = tc.recorder().traces(trace.trace_id)
+    assert len(rec) == 1 and rec[0]["name"] == "flush-hop"
+    assert rec[0]["parentSpanId"] == ctx.span_id
+    assert "inner" in rec[0]["spans"]
+
+
+def test_adopt_reads_parent_env(monkeypatch):
+    ctx = tc.TraceContext.root()
+    monkeypatch.setenv(tc.TRACE_ENV, ctx.encode())
+    with tracing.adopt("job") as trace:
+        assert trace.trace_id == ctx.trace_id
+    assert tc.recorder().traces(ctx.trace_id)[0]["name"] == "job"
+
+
+# ---------------------------------------------------------------------------
+# WriteBuffer: the flush span carries the submitting request's trace
+# ---------------------------------------------------------------------------
+
+def test_write_buffer_flush_carries_submit_trace():
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.write_buffer import WriteBuffer
+
+    class _Store:
+        def __init__(self):
+            self.rows = []
+
+        def insert_batch(self, events, app_id, channel_id=None):
+            self.rows.extend(events)
+            return [e.event_id for e in events]
+
+        insert_batch_idempotent = insert_batch
+
+    store = _Store()
+    reg = MetricsRegistry()
+    buf = WriteBuffer(store_fn=lambda: store, registry=reg, linger_s=0.0)
+    tokens, trace = tracing.start_trace("ingest-req", reg)
+    try:
+        fut = buf.submit([Event(event="rate", entity_type="user",
+                                entity_id="u1")], app_id=1)
+    finally:
+        tracing.reset_trace(tokens)
+    fut.result(timeout=10)
+    buf.stop()
+    recs = tc.recorder().traces(trace.trace_id)
+    assert [r["name"] for r in recs] == ["ingest_flush"]
+    assert recs[0]["attrs"]["events"] == 1
+    # the span histogram saw the flush stage too
+    hist = reg.get("pio_span_duration_seconds")
+    assert hist.count(span="ingest_flush") == 1
+
+
+def test_write_buffer_flush_untraced_submit_records_nothing():
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.write_buffer import WriteBuffer
+
+    class _Store:
+        def insert_batch(self, events, app_id, channel_id=None):
+            return [e.event_id for e in events]
+
+        insert_batch_idempotent = insert_batch
+
+    buf = WriteBuffer(store_fn=lambda: _Store(), linger_s=0.0)
+    buf.submit([Event(event="rate", entity_type="user",
+                      entity_id="u1")], app_id=1).result(timeout=10)
+    buf.stop()
+    assert tc.recorder().traces() == []
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher: executor batches carry the submitting request's trace
+# ---------------------------------------------------------------------------
+
+def test_micro_batcher_carries_submit_trace():
+    from predictionio_tpu.server.query_server import MicroBatcher
+
+    reg = MetricsRegistry()
+    batcher = MicroBatcher(lambda queries: [q * 2 for q in queries],
+                           max_batch=4, linger_s=0.0, registry=reg)
+
+    async def go():
+        tokens, trace = tracing.start_trace("query-req", reg)
+        try:
+            out = await batcher.submit(21)
+        finally:
+            tracing.reset_trace(tokens)
+        return trace, out
+
+    trace, out = asyncio.run(go())
+    assert out == 42
+    recs = tc.recorder().traces(trace.trace_id)
+    assert [r["name"] for r in recs] == ["serving_batch"]
+    assert recs[0]["attrs"]["batch"] == 1
+
+
+def test_micro_batcher_untraced_submit_skips_carry():
+    from predictionio_tpu.server.query_server import MicroBatcher
+
+    batcher = MicroBatcher(lambda queries: [q for q in queries],
+                           max_batch=4, linger_s=0.0)
+
+    async def go():
+        return await batcher.submit("ok")
+
+    assert asyncio.run(go()) == "ok"
+    assert tc.recorder().traces() == []
+
+
+# ---------------------------------------------------------------------------
+# HTTP propagation: header in, header out, recorder entry
+# ---------------------------------------------------------------------------
+
+@pytest.mark.anyio
+async def test_middleware_propagates_and_records():
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from predictionio_tpu.obs.middleware import (
+        add_metrics_routes, observability_middleware,
+    )
+
+    reg = MetricsRegistry()
+    app = web.Application(middlewares=[
+        observability_middleware(reg, "svc")])
+
+    async def handler(request):
+        with tracing.span("stage"):
+            pass
+        return web.json_response({"ok": True})
+
+    app.router.add_get("/x", handler)
+    add_metrics_routes(app, reg)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        parent = tc.TraceContext.root()
+        resp = await client.get("/x",
+                                headers={tc.TRACE_HEADER: parent.encode()})
+        assert resp.status == 200
+        echoed = tc.TraceContext.decode(resp.headers[tc.TRACE_HEADER])
+        assert echoed.trace_id == parent.trace_id
+
+        recs = tc.recorder().traces(parent.trace_id)
+        assert len(recs) == 1
+        assert recs[0]["parentSpanId"] == parent.span_id
+        assert "stage" in recs[0]["spans"]
+
+        # the flight recorder is served at /debug/traces.json
+        resp = await client.get("/debug/traces.json",
+                                params={"traceId": parent.trace_id})
+        body = await resp.json()
+        assert [t["traceId"] for t in body["traces"]] == [parent.trace_id]
+    finally:
+        await client.close()
+
+
+@pytest.mark.anyio
+async def test_middleware_tracing_off_skips_trace_layer(monkeypatch):
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from predictionio_tpu.obs.middleware import observability_middleware
+
+    monkeypatch.setenv(tracing.TRACING_ENV, "0")
+    reg = MetricsRegistry()
+    app = web.Application(middlewares=[
+        observability_middleware(reg, "svc")])
+
+    async def handler(request):
+        return web.json_response({"ok": True})
+
+    app.router.add_get("/x", handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.get("/x")
+        assert resp.status == 200
+        assert tc.TRACE_HEADER not in resp.headers
+        assert resp.headers.get("X-Request-ID")      # request ids stay
+        assert tc.recorder().traces() == []
+        # metrics still observe with tracing off
+        assert reg.get(
+            "pio_http_request_duration_seconds").total_count() == 1
+    finally:
+        await client.close()
+
+
+# ---------------------------------------------------------------------------
+# process hop: a batchpredict run joins the parent's trace
+# ---------------------------------------------------------------------------
+
+def _synth_result(nu=20, ni=12, rank=4):
+    from predictionio_tpu.core.engine import TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, RecommendationServing,
+    )
+    from predictionio_tpu.models.als import ALSModel
+
+    rng = np.random.default_rng(3)
+    model = ALSModel(
+        user_vocab=np.asarray([f"u{i}" for i in range(nu)], dtype=object),
+        item_vocab=np.asarray([f"i{i}" for i in range(ni)], dtype=object),
+        U=rng.normal(size=(nu, rank)).astype(np.float32),
+        V=rng.normal(size=(ni, rank)).astype(np.float32))
+    return TrainResult(
+        models=[model], algorithms=[ALSAlgorithm(AlgorithmParams())],
+        serving=RecommendationServing(), engine_params=EngineParams())
+
+
+def test_batch_predict_adopts_parent_trace(tmp_path, monkeypatch):
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    inp = tmp_path / "q.jsonl"
+    with open(inp, "w") as f:
+        for i in range(10):
+            f.write(json.dumps({"user": f"u{i}", "num": 3}) + "\n")
+    parent = tc.TraceContext.root()
+    monkeypatch.setenv(tc.TRACE_ENV, parent.encode())
+    rep = run_batch_predict(None, None, str(inp), str(tmp_path / "o.jsonl"),
+                            chunk_size=8, loaded=(_synth_result(), None))
+    assert rep.trace_id == parent.trace_id
+    recs = tc.recorder().traces(parent.trace_id)
+    assert any(r["name"] == "batchpredict" for r in recs)
+
+
+def test_batch_predict_roots_fresh_trace_without_parent(tmp_path,
+                                                        monkeypatch):
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    monkeypatch.delenv(tc.TRACE_ENV, raising=False)
+    inp = tmp_path / "q.jsonl"
+    with open(inp, "w") as f:
+        f.write(json.dumps({"user": "u1", "num": 3}) + "\n")
+    rep = run_batch_predict(None, None, str(inp), str(tmp_path / "o.jsonl"),
+                            chunk_size=8, loaded=(_synth_result(), None))
+    assert rep.trace_id
+    assert tc.recorder().traces(rep.trace_id)

@@ -1,0 +1,24 @@
+"""Project static analysis: the fleet's safety invariants, machine-checked.
+
+The conventions PRs 1-10 rest on — every durable write is temp-write +
+rename, every compiled fn rides the ``ops/fn_cache`` ledger, every thread
+hop carries the trace plane, no blocking I/O under a swap lock — lived in
+reviewers' heads plus three ad-hoc AST tests. This package turns them
+into a checker engine (`pio check`):
+
+* :mod:`predictionio_tpu.analysis.model` — parsed sources, suppression
+  comments (``# pio: ignore[RULE]: reason``), virtual projects for tests;
+* :mod:`predictionio_tpu.analysis.callgraph` — the cross-module
+  function/call index whole-program passes reason over;
+* :mod:`predictionio_tpu.analysis.registry` — the knob/committer/lock
+  tables derived from the modules that define those disciplines;
+* :mod:`predictionio_tpu.analysis.engine` — the checker SPI, baseline
+  semantics, JSON/human reports;
+* :mod:`predictionio_tpu.analysis.checkers` — the shipped rules
+  (PIO001-PIO008 project invariants, PIO100-PIO102 ported legacy gates).
+"""
+
+from predictionio_tpu.analysis.engine import (   # noqa: F401
+    Baseline, Checker, Finding, Report, all_rules, run_check,
+)
+from predictionio_tpu.analysis.model import Project, SourceFile  # noqa: F401

@@ -1,0 +1,39 @@
+"""The shipped `pio check` rules.
+
+PIO001-PIO008 encode the fleet's safety invariants (compile ledger,
+commit discipline, trace plane, lock hygiene, kill points, knob
+precedence, trace-time determinism, wire determinism). PIO100-PIO102
+are the three pre-existing ad-hoc static tests folded into the
+framework; their old test files are now thin wrappers over the engine.
+"""
+
+from predictionio_tpu.analysis.checkers.compile_ledger import (
+    BareJit, TracedNondeterminism,
+)
+from predictionio_tpu.analysis.checkers.durable_writes import (
+    UncommittedDurableWrite,
+)
+from predictionio_tpu.analysis.checkers.exceptions import (
+    SwallowedKillPoint,
+)
+from predictionio_tpu.analysis.checkers.knobs import UnregisteredKnobRead
+from predictionio_tpu.analysis.checkers.legacy import (
+    EngineRowFind, MetricDocsDrift, StrayPrint,
+)
+from predictionio_tpu.analysis.checkers.locks import BlockingUnderLock
+from predictionio_tpu.analysis.checkers.threads import UncarriedThreadHop
+from predictionio_tpu.analysis.checkers.wire import WireNondeterminism
+
+ALL_CHECKERS = [
+    BareJit,                    # PIO001
+    UncommittedDurableWrite,    # PIO002
+    UncarriedThreadHop,         # PIO003
+    BlockingUnderLock,          # PIO004
+    SwallowedKillPoint,         # PIO005
+    UnregisteredKnobRead,       # PIO006
+    TracedNondeterminism,       # PIO007
+    WireNondeterminism,         # PIO008
+    StrayPrint,                 # PIO100
+    MetricDocsDrift,            # PIO101
+    EngineRowFind,              # PIO102
+]

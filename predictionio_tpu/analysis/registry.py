@@ -1,0 +1,168 @@
+"""Project tables the checkers reason against.
+
+Everything here is *derived from the modules that define the
+discipline* rather than restated by hand where possible: the knob table
+auto-registers every ``PIO_*`` literal in ``utils/server_config.py``
+(the env > engine.json > server.json precedence lives there), and the
+explicit entries below cover only the plumbing knobs that legitimately
+bypass it (process wiring, chaos injection, kill switches) — each with
+the module(s) allowed to read it. PIO006 flags any other read, which
+makes adding a knob a two-line change *here* instead of a convention.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Optional, Tuple
+
+from predictionio_tpu.analysis.model import Project
+
+KNOB_RE = re.compile(r"^PIO_[A-Z0-9_]+$")
+
+SERVER_CONFIG_PATH = "predictionio_tpu/utils/server_config.py"
+
+#: knobs read OUTSIDE utils/server_config.py, with their owner modules.
+#: An env read of a PIO_* name anywhere else is a PIO006 finding: either
+#: route it through ServerConfig or register (and justify) it here.
+KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
+    # process/fleet wiring — consumed before any config file exists
+    "PIO_NUM_PROCESSES": ("predictionio_tpu/parallel/distributed.py",
+                          "predictionio_tpu/obs/trace_context.py"),
+    "PIO_PROCESS_ID": ("predictionio_tpu/parallel/distributed.py",
+                       "predictionio_tpu/obs/trace_context.py"),
+    "PIO_COORDINATOR_ADDRESS": ("predictionio_tpu/parallel/distributed.py",),
+    "PIO_TRACE_CONTEXT": ("predictionio_tpu/obs/trace_context.py",),
+    "PIO_HOME": ("predictionio_tpu/utils/config.py",
+                 "predictionio_tpu/storage/registry.py"),
+    # observability kill switches — read on import/request paths that
+    # must work even when config loading is what broke
+    "PIO_TRACING": ("predictionio_tpu/obs/tracing.py",),
+    "PIO_SLO": ("predictionio_tpu/obs/slo.py",),
+    "PIO_DISPATCH_ATTRIBUTION": ("predictionio_tpu/obs/profiler.py",),
+    "PIO_SLOW_REQUEST_SECONDS": ("predictionio_tpu/obs/middleware.py",),
+    # chaos injection — deliberately env-only so a chaos run can never
+    # be committed into a config file
+    "PIO_FAULT_KILL": ("predictionio_tpu/storage/faults.py",),
+    "PIO_FAULT_OPS": ("predictionio_tpu/storage/faults.py",),
+    "PIO_FAULT_SEED": ("predictionio_tpu/storage/faults.py",),
+    "PIO_FAULT_ERROR_RATE": ("predictionio_tpu/storage/faults.py",),
+    "PIO_FAULT_LATENCY_S": ("predictionio_tpu/storage/faults.py",),
+    "PIO_FAULT_FAIL_N": ("predictionio_tpu/storage/faults.py",),
+    "PIO_FAULT_WHEN": ("predictionio_tpu/storage/faults.py",),
+    # module-local performance/debug toggles, registered with owners
+    "PIO_EVLOG_CODEC": ("predictionio_tpu/native/evlog.py",),
+    "PIO_EVAL_VECTORIZE": ("predictionio_tpu/core/evaluation.py",),
+    "PIO_EVAL_BATCH_MAX": ("predictionio_tpu/models/als_sweep.py",),
+    "PIO_EVAL_CHUNK_MB": ("predictionio_tpu/models/als_sweep.py",),
+    "PIO_ENTITY_CACHE_TTL_S": ("predictionio_tpu/engines/common.py",),
+    "PIO_TPU_SOLVE": ("predictionio_tpu/ops/linalg.py",),
+    "PIO_INGEST_CACHE": ("predictionio_tpu/data/ingest.py",),
+    "PIO_VIEW_CACHE_DIR": ("predictionio_tpu/data/view.py",),
+    # read only by the test suite (documented, so registered)
+    "PIO_TEST_POSTGRES_URL": ("tests/",),
+}
+
+#: knob *families* read via pattern scan (no literal name per knob) —
+#: matched by prefix in the knob-docs gate and by PIO006
+KNOB_PREFIXES: Dict[str, Tuple[str, ...]] = {
+    "PIO_STORAGE_SOURCES_": ("predictionio_tpu/storage/registry.py",),
+    "PIO_STORAGE_REPOSITORIES_": ("predictionio_tpu/storage/registry.py",),
+    "PIO_FAULT_": ("predictionio_tpu/storage/faults.py",),
+}
+
+
+def server_config_knobs(project: Project) -> Tuple[str, ...]:
+    """Every PIO_* string literal in utils/server_config.py — those
+    knobs are owned by the config precedence chain itself."""
+    f = project.file(SERVER_CONFIG_PATH)
+    if f is None:
+        return ()
+    names = set()
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and KNOB_RE.match(node.value):
+            names.add(node.value)
+    return tuple(sorted(names))
+
+
+def knob_table(project: Project) -> Dict[str, Tuple[str, ...]]:
+    """knob name -> module paths allowed to read it directly."""
+    table = dict(KNOB_OWNERS)
+    for name in server_config_knobs(project):
+        table.setdefault(name, ())
+        table[name] = tuple(dict.fromkeys(
+            table[name] + (SERVER_CONFIG_PATH,)))
+    return table
+
+
+def owner_for(table: Dict[str, Tuple[str, ...]], knob: str
+              ) -> Optional[Tuple[str, ...]]:
+    """Owners of a knob, resolving prefix families; None = unregistered."""
+    if knob in table:
+        return table[knob]
+    for prefix, owners in KNOB_PREFIXES.items():
+        if knob.startswith(prefix):
+            return owners
+    return None
+
+
+# -- PIO002: the temp-write + rename commit discipline -----------------------
+
+#: dotted call paths that COMMIT a durable file (the rename side)
+COMMIT_DOTTED = frozenset({"os.replace", "os.rename"})
+#: method names that commit on a filesystem object (fs.mv(tmp, path))
+COMMIT_ATTRS = frozenset({"mv"})
+
+# -- PIO003: trace-plane carriers --------------------------------------------
+
+#: calling any of these means the hop participates in the trace plane
+TRACE_CARRIERS = frozenset({"carried", "capture_context", "adopt"})
+#: executor receivers whose .submit(fn, ...) is a thread hop
+EXECUTOR_NAME_RE = re.compile(r"(executor|pool)", re.IGNORECASE)
+
+# -- PIO004: no blocking work under a held lock ------------------------------
+
+LOCK_NAME_RE = re.compile(r"lock", re.IGNORECASE)
+#: paths where lock bodies are latency-critical (swap/serving/metrics)
+LOCK_SCOPE_PREFIXES = ("predictionio_tpu/deploy/", "predictionio_tpu/obs/")
+LOCK_SCOPE_FILES = ("predictionio_tpu/data/write_buffer.py",
+                    "predictionio_tpu/server/query_server.py")
+#: dotted paths / method names that block
+BLOCKING_DOTTED = frozenset({
+    "time.sleep", "os.replace", "os.rename", "os.fsync",
+    "urllib.request.urlopen", "subprocess.run", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "requests.get", "requests.post", "requests.request",
+    "socket.create_connection",
+})
+BLOCKING_ATTRS = frozenset({"result"})      # concurrent.futures waits
+BLOCKING_BUILTINS = frozenset({"open"})
+
+# -- PIO007: nondeterminism inside traced/jitted functions -------------------
+
+NONDET_DOTTED = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "uuid.uuid4", "uuid.uuid1",
+})
+NONDET_MODULE_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+# -- PIO008: serialized wire paths -------------------------------------------
+
+WIRE_MODULES = (
+    "predictionio_tpu/data/event.py",
+    "predictionio_tpu/data/columnar.py",
+    "predictionio_tpu/workflow/serialization.py",
+    "predictionio_tpu/obs/fleet.py",
+)
+
+# -- scopes ------------------------------------------------------------------
+
+#: the compile-ledger module itself is exempt from PIO001
+FN_CACHE_PATH = "predictionio_tpu/ops/fn_cache.py"
+#: builder-registering entry points of the compile ledger
+FN_CACHE_BUILDERS = {"mesh_cached_fn": 3, "shape_cached_fn": 2}
+
+ENGINES_PREFIX = "predictionio_tpu/engines/"
+PKG_PREFIX = "predictionio_tpu/"

@@ -974,6 +974,8 @@ def import_cmd(appid, appname, channel, input_path):
               default="json")
 def export_cmd(appid, appname, channel, output_path, fmt):
     """Export events to a file (Console.scala:606, EventsToFile.scala:40)."""
+    import os
+
     from predictionio_tpu.data.eventstore import resolve_app
     from predictionio_tpu.storage import Storage, StorageError
 
@@ -989,18 +991,30 @@ def export_cmd(appid, appname, channel, output_path, fmt):
         click.echo("[ERROR] --appid or --appname is required.")
         sys.exit(1)
     store = Storage.get_events()
-    if fmt == "parquet":
-        import pyarrow.parquet as pq
+    # temp-write + rename: an interrupted export must never leave a
+    # truncated file that looks like a complete dump (the import side
+    # has no way to tell "all the events" from "the first half")
+    tmp = f"{output_path}.tmp-{os.getpid()}"
+    try:
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
 
-        table = store.find_columnar(app_id, channel_id)
-        pq.write_table(table, output_path)
-        n = table.num_rows
-    else:
-        n = 0
-        with open(output_path, "w") as f:
-            for e in store.find(app_id, channel_id):
-                f.write(e.to_json() + "\n")
-                n += 1
+            table = store.find_columnar(app_id, channel_id)
+            pq.write_table(table, tmp)
+            n = table.num_rows
+        else:
+            n = 0
+            with open(tmp, "w") as f:
+                for e in store.find(app_id, channel_id):
+                    f.write(e.to_json() + "\n")
+                    n += 1
+        os.replace(tmp, output_path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     click.echo(f"[INFO] Exported {n} events to {output_path}.")
 
 
@@ -1172,15 +1186,117 @@ def template_get(name, directory):
     target_dir = directory or name
     os.makedirs(target_dir, exist_ok=True)
     target = os.path.join(target_dir, "engine.json")
-    with open(target, "w") as f:
-        json.dump({
-            "id": "default",
-            "description": f"{name} engine",
-            "engineFactory": factory,
-            "datasource": {"params": ds_params},
-            "algorithms": algos,
-        }, f, indent=2)
+    # temp-write + rename: engine.json is the deploy surface — a crash
+    # here must leave the previous template or nothing, never half a file
+    tmp = f"{target}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({
+                "id": "default",
+                "description": f"{name} engine",
+                "engineFactory": factory,
+                "datasource": {"params": ds_params},
+                "algorithms": algos,
+            }, f, indent=2)
+        os.replace(tmp, target)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     click.echo(f"[INFO] Engine template {name} written to {target}")
+
+
+@cli.command()
+@click.argument("paths", nargs=-1)
+@click.option("--rule", "-r", "rules", multiple=True,
+              help="Run only these rule ids (repeatable), e.g. -r PIO002.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable report on stdout.")
+@click.option("--baseline", "baseline_path", default=None,
+              help="Baseline file of grandfathered findings "
+                   "(default: conf/pio_check_baseline.json when present).")
+@click.option("--write-baseline", is_flag=True,
+              help="Rewrite the baseline to absorb every current finding.")
+@click.option("--no-baseline", is_flag=True,
+              help="Report every finding, ignoring any baseline.")
+@click.option("--list-rules", is_flag=True,
+              help="List the shipped rule ids and exit.")
+def check(paths, rules, as_json, baseline_path, write_baseline,
+          no_baseline, list_rules):
+    """Static analysis: enforce the fleet's safety invariants.
+
+    Scans predictionio_tpu/ plus bench.py (or just PATHS, root-relative)
+    with the checker engine; exits 1 when any finding is not covered by
+    the committed baseline or an inline `# pio: ignore[RULE]: reason`.
+    """
+    import pathlib
+
+    import predictionio_tpu
+    from predictionio_tpu.analysis import Baseline, Project, run_check
+    from predictionio_tpu.analysis.engine import DEFAULT_BASELINE, all_rules
+
+    if list_rules:
+        for rid, title in sorted(all_rules().items()):
+            click.echo(f"{rid}  {title}")
+        return
+    if write_baseline and (rules or paths):
+        # a partial run would rewrite the baseline WITHOUT the entries
+        # the filtered-out rules/files still need, silently un-
+        # grandfathering them
+        click.echo("[ERROR] --write-baseline regenerates the whole "
+                   "baseline; it cannot be combined with --rule or PATHS.")
+        sys.exit(2)
+    root = pathlib.Path(predictionio_tpu.__file__).resolve().parent.parent
+    # ALWAYS parse the full tree: whole-program rules (committer
+    # reachability, builder routing, docs drift) need it; PATHS only
+    # filters which files findings are reported for
+    project = Project.from_root(root)
+    scanned = {f.path for f in project.files}
+    norm_paths = []
+    for p in paths:
+        # PATHS are project-root-relative; normalize `./`, `..`, and
+        # absolute spellings to the scanned form so a mistyped path can
+        # never silently filter every finding away and report clean
+        base = pathlib.Path(p) if pathlib.Path(p).is_absolute() \
+            else root / p
+        try:
+            norm = base.resolve().relative_to(root).as_posix()
+        except ValueError:
+            click.echo(f"[ERROR] {p} is outside the project root {root}.")
+            sys.exit(2)
+        if not any(s == norm or s.startswith(norm + "/")
+                   for s in scanned):
+            click.echo(f"[ERROR] {p} matches no scanned file "
+                       "(paths are relative to the project root, e.g. "
+                       "predictionio_tpu/deploy/foldin.py).")
+            sys.exit(2)
+        norm_paths.append(norm)
+    baseline = Baseline()
+    resolved = pathlib.Path(baseline_path) if baseline_path \
+        else root / DEFAULT_BASELINE
+    if not no_baseline and not write_baseline and resolved.is_file():
+        baseline = Baseline.load(resolved)
+    try:
+        report = run_check(project, rules=rules or None, baseline=baseline,
+                           paths=norm_paths or None)
+    except ValueError as e:
+        click.echo(f"[ERROR] {e}")
+        sys.exit(2)
+    if write_baseline:
+        Baseline.from_findings(
+            report.findings + report.baselined).save(resolved)
+        click.echo(f"[INFO] baseline written to {resolved} "
+                   f"({len(report.findings) + len(report.baselined)} "
+                   "findings absorbed)")
+        return
+    if as_json:
+        click.echo(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        click.echo(report.render())
+    if not report.ok:
+        sys.exit(1)
 
 
 @cli.command()

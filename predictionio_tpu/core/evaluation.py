@@ -427,11 +427,21 @@ class MetricEvaluator:
         return result
 
     def _save_best_json(self, ep: EngineParams) -> None:
-        """MetricEvaluator.saveEngineJson:193 — the deployable best variant."""
+        """MetricEvaluator.saveEngineJson:193 — the deployable best variant.
+
+        Temp-write + rename: this file is what `pio deploy` reads, so a
+        crash mid-write must leave either the previous best or nothing —
+        never a torn JSON that a deploy then ships."""
+        tmp = f"{self.output_path}.tmp-{os.getpid()}"
         try:
-            with open(self.output_path, "w") as f:
+            with open(tmp, "w") as f:
                 json.dump(ep.to_json_dict(), f, indent=2, sort_keys=True)
+            os.replace(tmp, self.output_path)
             logger.info("best engine params written to %s",
                         os.path.abspath(self.output_path))
         except OSError as e:
             logger.warning("cannot write %s: %s", self.output_path, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

@@ -147,10 +147,15 @@ def _start_attempt(fn, args) -> "concurrent.futures.Future":
     the backend call whenever it finally returns.
     """
     f: concurrent.futures.Future = concurrent.futures.Future()
+    # the attempt thread re-enters the flush's trace so a slow backend
+    # call shows up inside the ingest request's span tree, not as an
+    # orphan (record=False: the carried flush span already records)
+    ctx = capture_context()
 
     def run():
         try:
-            f.set_result(fn(*args))
+            with carried(ctx, "ingest_flush_attempt", record=False):
+                f.set_result(fn(*args))
         except BaseException as e:  # noqa: BLE001 — relayed to the waiter
             f.set_exception(e)
 

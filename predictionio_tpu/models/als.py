@@ -1080,6 +1080,7 @@ def device_roundtrip_s() -> float:
     if _DEVICE_ROUNDTRIP_S is None:
         import time
 
+        # pio: ignore[PIO001]: one-shot roundtrip probe; result memoized in _DEVICE_ROUNDTRIP_S
         probe = jax.jit(lambda a: jax.lax.top_k(a @ a.T, 4))
         x = np.ones((8, 8), np.float32)
         jax.block_until_ready(probe(x))          # compile outside the clock

@@ -129,14 +129,14 @@ def _sharded_count_fn(mesh, axis: str, n_labels: int):
     return mesh_cached_fn("nb_count", mesh, (axis, n_labels), build)
 
 
-_count_fns: Dict[int, Callable] = {}
-
-
 def _count_fn(n_labels: int):
     """Stable single-device count jit per label count (a per-call jit
-    would recompile every train — seconds over a remote-compile relay)."""
-    fn = _count_fns.get(n_labels)
-    if fn is None:
+    would recompile every train — seconds over a remote-compile relay).
+    Ledger-cached so the per-label-count programs show up bounded in
+    ``pio_jax_compile_total{family=nb_count_host}``."""
+    from predictionio_tpu.ops.fn_cache import shape_cached_fn
+
+    def build():
         import jax
         import jax.numpy as jnp
 
@@ -145,8 +145,9 @@ def _count_fn(n_labels: int):
             onehot = jax.nn.one_hot(codes, n_labels, dtype=jnp.float32)
             return onehot.T @ x.astype(jnp.float32)
 
-        fn = _count_fns[n_labels] = count
-    return fn
+        return count
+
+    return shape_cached_fn("nb_count_host", n_labels, build)
 
 
 def _compact_for_transfer(X: np.ndarray) -> np.ndarray:
@@ -164,14 +165,13 @@ def _compact_for_transfer(X: np.ndarray) -> np.ndarray:
     return X.astype(np.uint8 if xmax < 256 else np.uint16)
 
 
-_score_jit = None      # stable jit: per-call wrappers would re-trace
-                       # (and re-COMPILE — seconds per call over a
-                       # remote-compile relay) on every predict
-
-
 def _score_fn():
-    global _score_jit
-    if _score_jit is None:
+    """Stable scoring jit (a per-call wrapper would re-trace — and
+    re-COMPILE, seconds over a remote-compile relay — every predict);
+    one ledger entry under ``family=nb_score``."""
+    from predictionio_tpu.ops.fn_cache import shape_cached_fn
+
+    def build():
         import jax
         import jax.numpy as jnp
 
@@ -179,8 +179,9 @@ def _score_fn():
         def score(x, lp, pri):
             return x.astype(jnp.float32) @ lp.T + pri[None, :]
 
-        _score_jit = score
-    return _score_jit
+        return score
+
+    return shape_cached_fn("nb_score", (), build)
 
 
 #: device predict only pays off above this element count when the input

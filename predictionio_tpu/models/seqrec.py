@@ -377,9 +377,14 @@ def train_seqrec(mesh: Optional[Mesh], sessions: Sequence[Sequence[str]],
     if mesh is not None and jax.process_count() > 1:
         # tp-sharded leaves span processes (not host-addressable); one
         # jitted identity with replicated out_shardings gathers them over
-        # the interconnect so every host can extract the full model
-        replicate = jax.jit(
-            lambda t: t, out_shardings=NamedSharding(mesh, P()))
+        # the interconnect so every host can extract the full model —
+        # ledger-cached per mesh so retrains don't re-trace the gather
+        from predictionio_tpu.ops.fn_cache import mesh_cached_fn
+
+        replicate = mesh_cached_fn(
+            "seqrec_replicate", mesh, (),
+            lambda: jax.jit(lambda t: t,
+                            out_shardings=NamedSharding(mesh, P())))
         params = replicate(params)
     host = jax.tree.map(np.asarray, params)
     return SeqRecModel(item_vocab=all_items, params=host, hyper=p)

@@ -237,22 +237,33 @@ def ring_attention_traced(q: jax.Array, k: jax.Array, v: jax.Array,
     return fn(q, k, v, key_mask)
 
 
-@functools.lru_cache(maxsize=64)
 def _sharded_fn(local_fn, mesh: Mesh, axis: str, causal: bool,
                 batch_axis: Optional[str] = None):
     """Cache the jitted shard_map wrapper per (mesh, axis, causal,
-    batch_axis) so repeated calls reuse the compiled executable instead of
-    re-tracing. `batch_axis` additionally shards the batch dim (dp
-    composed with the sequence collective, which only spans `axis`)."""
-    spec = P(batch_axis, axis, None, None)
-    mask_spec = P(batch_axis, axis)
-    return jax.jit(shard_map(
-        functools.partial(local_fn, axis=axis, causal=causal,
-                          batch_axis=batch_axis),
-        mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec,
-        # the vma marking (pcast_varying on the scan carries) satisfies
-        # the new checker; the old replication checker has no equivalent
-        check_vma=HAS_VMA))
+    batch_axis) so repeated calls reuse the compiled executable instead
+    of re-tracing. Routed through the ops/fn_cache ledger (was a private
+    lru_cache) so attention wrapper builds count into
+    ``pio_jax_compile_total{family=attention_<impl>}`` and get dispatch
+    attribution like every other compiled family. `batch_axis`
+    additionally shards the batch dim (dp composed with the sequence
+    collective, which only spans `axis`)."""
+    from predictionio_tpu.ops.fn_cache import mesh_cached_fn
+
+    def build():
+        spec = P(batch_axis, axis, None, None)
+        mask_spec = P(batch_axis, axis)
+        return jax.jit(shard_map(
+            functools.partial(local_fn, axis=axis, causal=causal,
+                              batch_axis=batch_axis),
+            mesh=mesh, in_specs=(spec, spec, spec, mask_spec),
+            out_specs=spec,
+            # the vma marking (pcast_varying on the scan carries)
+            # satisfies the new checker; the old replication checker has
+            # no equivalent
+            check_vma=HAS_VMA))
+
+    return mesh_cached_fn(f"attention_{local_fn.__name__.strip('_')}",
+                          mesh, (axis, causal, batch_axis), build)
 
 
 def _ulysses_local(q, k, v, key_mask, *, axis: str, causal: bool,

@@ -68,7 +68,7 @@ from predictionio_tpu.obs import batch_stats
 from predictionio_tpu.obs import fleet as obs_fleet
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
 from predictionio_tpu.obs.trace_context import from_env, recorder
-from predictionio_tpu.obs.tracing import carried, span
+from predictionio_tpu.obs.tracing import capture_context, carried, span
 from predictionio_tpu.ops.bucketing import bucket_size, padding_waste
 from predictionio_tpu.parallel.distributed import (
     contiguous_range, resolve_worker,
@@ -956,11 +956,17 @@ def _run_pipeline(chunks, scorer: _ChunkScorer, writer: _Writer,
             except queue.Empty:
                 continue
 
+    # both stage threads re-enter the run's trace (the shard runs under
+    # tracing.adopt) so decode/commit I/O attributes to the batchpredict
+    # trace id; record=False — the run-level span already records
+    ctx = capture_context()
+
     def read_loop() -> None:
         try:
-            for rows in chunks:
-                _put(in_q, rows)
-            _put(in_q, _EOF)
+            with carried(ctx, "bp_reader", record=False):
+                for rows in chunks:
+                    _put(in_q, rows)
+                _put(in_q, _EOF)
         except _StageFailed:
             pass
         except BaseException as e:       # noqa: BLE001 — incl. CrashError
@@ -969,11 +975,12 @@ def _run_pipeline(chunks, scorer: _ChunkScorer, writer: _Writer,
 
     def write_loop() -> None:
         try:
-            while True:
-                item = _get(out_q)
-                if item is _EOF:
-                    return
-                writer.write_chunk(*item)
+            with carried(ctx, "bp_writer", record=False):
+                while True:
+                    item = _get(out_q)
+                    if item is _EOF:
+                        return
+                    writer.write_chunk(*item)
         except _StageFailed:
             pass
         except BaseException as e:       # noqa: BLE001 — incl. CrashError

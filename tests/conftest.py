@@ -39,3 +39,15 @@ def mesh8():
     devices = np.array(jax.devices()[:8])
     assert devices.size == 8, "conftest should have forced 8 host devices"
     return Mesh(devices, axis_names=("data",))
+
+
+@pytest.fixture(scope="session")
+def repo_project():
+    """The real tree parsed ONCE for every static-analysis gate
+    (`pio check` rules; see predictionio_tpu/analysis/)."""
+    import pathlib
+
+    from predictionio_tpu.analysis import Project
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    return Project.from_root(root)

@@ -1,133 +1,51 @@
 """Doc drift gate: the OBSERVABILITY.md metric inventory can no longer
 silently rot.
 
-Two static assertions:
+Now a thin wrapper over the `pio check` engine — the collector moved to
+predictionio_tpu/analysis/checkers/legacy.py as rule PIO101. The same
+two assertions hold:
 
 * every ``pio_*`` metric name registered anywhere under
-  ``predictionio_tpu/`` (literal first argument to a registry
-  ``counter``/``gauge``/``gauge_callback``/``histogram`` call, or a
-  module-level UPPER_CASE string constant naming one) appears in
-  OBSERVABILITY.md;
-* every ``pio_*`` token OBSERVABILITY.md mentions is registered in code
-  (no documenting metrics that no longer exist).
+  ``predictionio_tpu/`` appears in OBSERVABILITY.md;
+* every ``pio_*`` token OBSERVABILITY.md mentions is registered in code.
 
-When this test fails you either added a metric without documenting it,
-or removed/renamed one without updating the inventory — fix the doc,
-not the test.
+When this fails you either added a metric without documenting it, or
+removed/renamed one without updating the inventory — fix the doc, not
+the test.
 """
 
-import ast
-import pathlib
-import re
-
-ROOT = pathlib.Path(__file__).resolve().parent.parent
-PKG = ROOT / "predictionio_tpu"
-DOC = ROOT / "OBSERVABILITY.md"
-
-REGISTRY_METHODS = {"counter", "gauge", "gauge_callback", "histogram"}
-METRIC_RE = re.compile(r"^pio_[a-z0-9_]+$")
-DOC_TOKEN_RE = re.compile(r"\bpio_[a-z0-9_]+\b")
-
-#: names OBSERVABILITY.md uses ONLY as illustrative examples in the
-#: "Using it from new code" section — not part of the real inventory
-DOC_EXAMPLE_WHITELIST = {"pio_cache_hits_total", "pio_upload_seconds"}
-
-#: workflow_run_metrics(workflow, metric_prefix) registers
-#: f"{prefix}_runs_total" + f"{prefix}_duration_seconds" — the one
-#: dynamic naming pattern in the tree, expanded per literal call site
-RUN_METRIC_SUFFIXES = ("_runs_total", "_duration_seconds")
+from predictionio_tpu.analysis import run_check
+from predictionio_tpu.analysis.checkers.legacy import (
+    documented_metric_names, registered_metric_names,
+)
 
 
-def _string_literals(node) -> set:
-    """Every string literal inside an expression (resolves conditional
-    assignments like `name = "a" if hit else "b"`)."""
-    out = set()
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
-            out.add(sub.value)
-    return out
-
-
-def _assigned_names(tree) -> dict:
-    """NAME -> {possible string values} for assignments anywhere in the
-    module (module constants and function-local name bindings alike;
-    scope-naive, which is fine for a drift gate)."""
-    consts = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            values = _string_literals(node.value)
-            if not values:
-                continue
-            for target in node.targets:
-                if isinstance(target, ast.Name):
-                    consts.setdefault(target.id, set()).update(values)
-    return consts
-
-
-def registered_metric_names() -> set:
-    names = set()
-    for path in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        consts = _assigned_names(tree)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not node.args:
-                continue
-            fn = node.func
-            fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
-                fn.id if isinstance(fn, ast.Name) else None)
-            if fn_name == "workflow_run_metrics" and len(node.args) >= 2:
-                prefix = node.args[1]
-                if isinstance(prefix, ast.Constant) \
-                        and isinstance(prefix.value, str):
-                    for suffix in RUN_METRIC_SUFFIXES:
-                        names.add(prefix.value + suffix)
-                continue
-            if fn_name == "_get_or_create" and len(node.args) >= 2:
-                # MetricsRegistry-internal registrations (the overflow
-                # counter): _get_or_create(Cls, name, ...)
-                arg = node.args[1]
-            elif fn_name in REGISTRY_METHODS:
-                arg = node.args[0]
-            else:
-                continue
-            candidates = set()
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                candidates.add(arg.value)
-            elif isinstance(arg, ast.Name):
-                candidates.update(consts.get(arg.id, ()))
-            names.update(v for v in candidates if METRIC_RE.match(v))
-    return names
-
-
-def documented_metric_names() -> set:
-    tokens = set(DOC_TOKEN_RE.findall(DOC.read_text()))
-    return {t for t in tokens if t not in DOC_EXAMPLE_WHITELIST}
-
-
-def test_every_registered_metric_is_documented():
-    registered = registered_metric_names()
+def test_every_registered_metric_is_documented(repo_project):
+    registered = registered_metric_names(repo_project)
     assert registered, "collector found no metrics — the gate is broken"
-    documented = documented_metric_names()
-    missing = sorted(registered - documented)
+    documented = documented_metric_names(
+        repo_project.doc_text("OBSERVABILITY.md"))
+    missing = sorted(set(registered) - documented)
     assert not missing, (
         f"metrics registered in code but absent from OBSERVABILITY.md: "
         f"{missing} — add them to the inventory")
 
 
-def test_every_documented_metric_is_registered():
-    registered = registered_metric_names()
-    documented = documented_metric_names()
-    stale = sorted(documented - registered)
+def test_every_documented_metric_is_registered(repo_project):
+    report = run_check(repo_project, rules=["PIO101"])
+    stale = [f.message for f in report.findings
+             if f.path == "OBSERVABILITY.md"]
     assert not stale, (
-        f"OBSERVABILITY.md mentions pio_* names no code registers: "
-        f"{stale} — the inventory rotted; remove or fix them")
+        "OBSERVABILITY.md mentions pio_* names no code registers — the "
+        f"inventory rotted; remove or fix them: {stale}")
+    assert not report.findings, [f.message for f in report.findings]
 
 
-def test_collector_sees_the_known_corners():
+def test_collector_sees_the_known_corners(repo_project):
     """The gate only has teeth if the collector actually resolves the
     tricky registration shapes: constants passed by name, and metrics
     registered inside methods."""
-    registered = registered_metric_names()
+    registered = registered_metric_names(repo_project)
     for probe in (
             "pio_jax_compile_total",            # module constant, by Name
             "pio_device_dispatch_seconds_total",  # same, obs/profiler.py

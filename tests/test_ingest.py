@@ -507,27 +507,16 @@ def test_engine_training_deterministic_on_columnar_path(backend):
 # Static check: training reads must not use the row-iterator API
 # ---------------------------------------------------------------------------
 
-def test_no_engine_uses_row_find_for_training():
+def test_no_engine_uses_row_find_for_training(repo_project):
     """`EventStoreClient.find` is the per-Event serving-era iterator; no
     engine module may call it anymore — training reads go through the
     columnar path (find_columnar / training_scan / aggregate_scan).
-    Serving-time `find_by_entity` lookups stay allowed."""
-    import ast
-    import pathlib
+    Serving-time `find_by_entity` lookups stay allowed. Thin wrapper
+    over `pio check` rule PIO102 (analysis/checkers/legacy.py)."""
+    from predictionio_tpu.analysis import run_check
 
-    engines = (pathlib.Path(__file__).resolve().parent.parent
-               / "predictionio_tpu" / "engines")
-    offenders = []
-    for path in sorted(engines.glob("*.py")):
-        tree = ast.parse(path.read_text(encoding="utf-8"))
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "find"
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id in ("EventStoreClient",
-                                               "PEventStore", "LEventStore")):
-                offenders.append(f"{path.name}:{node.lineno}")
+    report = run_check(repo_project, rules=["PIO102"])
+    offenders = [f"{f.path}:{f.line}" for f in report.findings]
     assert not offenders, (
         "per-Event row scans in engine training reads (use the columnar "
         "ingest path): " + ", ".join(offenders))

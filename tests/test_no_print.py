@@ -1,64 +1,28 @@
 """Static check: no stray print() calls in the package.
 
-All operational output must flow through logging or the obs metrics
-registry — print() bypasses both the structured slow-request log format
-and log-level control, and corrupts stdout-protocol subprocesses
-(distributed launchers).
-
-Uses the tokenize module rather than a regex so string literals
-(including multi-line docstrings containing example print() calls),
-comments, attribute access (`x.print(`), and names merely ending in
-"print" (fingerprint, ...) can never false-positive, and the
-`print=None` kwarg to aiohttp's run_app never matches.
+Now a thin wrapper over the `pio check` engine (rule PIO100 in
+predictionio_tpu/analysis/checkers/legacy.py, where the tokenize-based
+detector moved); the detector corner-case tests stay here as its
+regression net.
 """
 
-import io
-import pathlib
-import token
-import tokenize
-
-PKG = pathlib.Path(__file__).resolve().parent.parent / "predictionio_tpu"
-
-
-def _print_calls(source: str):
-    """Line numbers where the print *builtin* is called: NAME 'print'
-    immediately followed by '(', not preceded by '.' (method) and not
-    followed later by '=' at call position (kwarg is NAME '=' not '(')."""
-    toks = [t for t in tokenize.generate_tokens(io.StringIO(source).readline)
-            if t.type not in (token.NL, token.NEWLINE, token.INDENT,
-                              token.DEDENT, tokenize.COMMENT)]
-    out = []
-    for i, t in enumerate(toks):
-        if t.type != token.NAME or t.string != "print":
-            continue
-        if i + 1 >= len(toks) or toks[i + 1].string != "(":
-            continue
-        if i > 0 and toks[i - 1].string in (".", "def"):
-            continue
-        out.append(t.start[0])
-    return out
+from predictionio_tpu.analysis import run_check
+from predictionio_tpu.analysis.checkers.legacy import print_call_lines
 
 
 def test_detector_on_known_cases():
-    assert _print_calls("print('x')\n") == [1]
-    assert _print_calls("a = 1\nif x:\n    print(a)\n") == [3]
-    assert _print_calls("fingerprint(x)\n") == []
-    assert _print_calls("obj.print(x)\n") == []
-    assert _print_calls("run_app(app, print=None)\n") == []
-    assert _print_calls('"""example:\n\n    print(result)\n"""\n') == []
-    assert _print_calls("# print(x)\n") == []
+    assert print_call_lines("print('x')\n") == [1]
+    assert print_call_lines("a = 1\nif x:\n    print(a)\n") == [3]
+    assert print_call_lines("fingerprint(x)\n") == []
+    assert print_call_lines("obj.print(x)\n") == []
+    assert print_call_lines("run_app(app, print=None)\n") == []
+    assert print_call_lines('"""example:\n\n    print(result)\n"""\n') == []
+    assert print_call_lines("# print(x)\n") == []
 
 
-def test_no_print_calls_in_package():
-    offenders = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG.parent)
-        try:
-            lines = _print_calls(path.read_text(encoding="utf-8"))
-        except (tokenize.TokenError, SyntaxError) as e:
-            offenders.append(f"{rel}: unparseable: {e}")
-            continue
-        offenders.extend(f"{rel}:{lineno}" for lineno in lines)
+def test_no_print_calls_in_package(repo_project):
+    report = run_check(repo_project, rules=["PIO100"])
+    offenders = [f"{f.path}:{f.line}" for f in report.findings]
     assert not offenders, (
         "stray print() calls (use logging or the obs registry):\n"
         + "\n".join(offenders))

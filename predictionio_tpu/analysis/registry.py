@@ -61,6 +61,26 @@ KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
     "PIO_VIEW_CACHE_DIR": ("predictionio_tpu/data/view.py",),
     # read only by the test suite (documented, so registered)
     "PIO_TEST_POSTGRES_URL": ("tests/",),
+    # continuous-training orchestrator knob chain (env > engine.json
+    # "orchestrator" > server.json) — resolved by OrchestratorConfig in
+    # server_config like every other section; registered here explicitly
+    # so the orchestrator's knob surface is enumerable by rule tooling
+    "PIO_ORCH_INTERVAL_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_COOLDOWN_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_MIN_INGEST_EVENTS": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_FOLDIN_PENDING_MAX": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_SLO_TRIGGER": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_PHASE_TIMEOUT_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_PHASE_RETRIES": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_PHASE_BACKOFF_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_PHASE_BACKOFF_CAP_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_CYCLE_BACKOFF_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_CYCLE_BACKOFF_CAP_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_MIN_EVAL_SCORE": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_CANARY_HOLD_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_CANARY_VERDICT_TIMEOUT_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_SMOKE_QUERIES": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_STATE_DIR": (SERVER_CONFIG_PATH,),
 }
 
 #: knob *families* read via pattern scan (no literal name per knob) —

@@ -701,6 +701,91 @@ def slo(ip, port):
 
 
 @cli.command()
+@click.option("--variant", "-v", default="engine.json")
+@click.option("--once", is_flag=True,
+              help="One trigger evaluation (and one cycle if it fires), "
+                   "then exit.")
+@click.option("--force", is_flag=True,
+              help="Fire one manual cycle immediately (skips the "
+                   "data-driven triggers and the cooldown window).")
+@click.option("--cycles", type=int, default=None,
+              help="Exit after this many completed cycles (default: "
+                   "run forever).")
+@click.option("--server", default=None, metavar="HOST:PORT",
+              help="Drive a live query server's deploy API for the "
+                   "canary phase (default: registry-only plane).")
+@click.option("--accesskey", default=None)
+@click.option("--state-dir", default=None,
+              help="Crash-safe cycle-document directory (default "
+                   "$PIO_HOME/orchestrator or PIO_ORCH_STATE_DIR).")
+@click.option("--eval-class", default=None,
+              help="Dotted Evaluation path for the eval-gate phase "
+                   "(skipped when absent, like `pio eval`'s argument).")
+def orchestrate(variant, once, force, cycles, server, accesskey,
+                state_dir, eval_class):
+    """Continuous-training orchestrator: the closed Lambda loop.
+
+    Recurring train -> eval-gate -> batchpredict smoke -> SLO-judged
+    canary -> promote over the release registry, with crash-safe phase
+    state (kill it anywhere; the next start converges), data-driven
+    retrain triggers (ingest volume, fold-in pressure, SLO burn) and
+    jittered backoff on failure. README "Continuous training".
+    """
+    import os
+
+    from predictionio_tpu.deploy.orchestrator import build_orchestrator
+
+    if not os.path.exists(variant):
+        click.echo(f"[ERROR] {variant} does not exist. Aborting.")
+        sys.exit(1)
+    orch = build_orchestrator(variant, eval_path=eval_class,
+                              server=server, access_key=accesskey,
+                              state_dir=state_dir)
+    cfg = orch.cfg
+    click.echo(f"[INFO] Orchestrating {orch.engine_id}/"
+               f"{orch.engine_variant} (state in {orch.store.state_dir})")
+    click.echo(f"[INFO] Triggers: ingest>={cfg.min_ingest_events or 'off'}"
+               f" foldin>={cfg.foldin_pending_max or 'off'}"
+               f" slo={'on' if cfg.slo_trigger else 'off'}; "
+               f"cooldown {cfg.cooldown_s:g}s, check every "
+               f"{cfg.interval_s:g}s")
+    click.echo(f"[INFO] Canary plane: "
+               + (f"live server {server}" if server
+                  else "release registry"))
+    if once or force:
+        action = orch.recover()
+        if action:
+            click.echo(f"[INFO] Recovery: {action}")
+        doc = orch.tick(force=force)
+        if doc is None:
+            click.echo("[INFO] No trigger fired; nothing to do.")
+            return
+        _echo_cycle(doc)
+        if doc.outcome != "promoted":
+            sys.exit(1)
+        return
+    try:
+        done = orch.run(cycles=cycles)
+    except KeyboardInterrupt:
+        click.echo("[INFO] Orchestrator stopped.")
+        return
+    click.echo(f"[INFO] Orchestrator exiting after {done} cycle(s).")
+
+
+def _echo_cycle(doc) -> None:
+    click.echo(f"[INFO] Cycle {doc.cycle_id} ({doc.trigger}): "
+               f"{doc.outcome} — {doc.reason}")
+    if doc.candidate_release_version:
+        click.echo(f"[INFO]   candidate release "
+                   f"v{doc.candidate_release_version}"
+                   + (f" | eval score {doc.eval_score}"
+                      if doc.eval_score is not None else ""))
+    trace = (doc.trace or ":").split(":")[0]
+    click.echo(f"[INFO]   trace id {trace} (follow with `pio traces "
+               f"--trace-id {trace}` on a live server)")
+
+
+@cli.command()
 @click.option("--ip", default="localhost")
 @click.option("--port", default=8000, type=int)
 @click.option("--accesskey", default=None)

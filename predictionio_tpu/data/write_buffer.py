@@ -21,8 +21,8 @@ Three mechanisms:
   the event server turns into ``429 Retry-After`` (explicit load
   shedding instead of the silent executor-queue growth it replaces).
 * **fault tolerance** — every event is assigned its id at SUBMIT time, so
-  a flush is idempotent: retries (exponential backoff + decorrelated
-  jitter, bounded attempts) go through
+  a flush is idempotent: retries (exponential backoff + full jitter via
+  the shared ``utils/retry`` policy, bounded attempts) go through
   ``EventStore.insert_batch_idempotent`` which skips ids already
   persisted — a fault after the backend committed cannot duplicate, a
   fault before it cannot lose (the request future fails only when every
@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
-import random
 import threading
 import time
 from collections import deque
@@ -48,6 +47,7 @@ from typing import Callable, List, Optional, Sequence
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.obs.tracing import capture_context, carried, span
 from predictionio_tpu.storage.base import StorageError, generate_id
+from predictionio_tpu.utils.retry import RetryPolicy, start_attempt_thread
 
 logger = logging.getLogger("pio.writebuffer")
 
@@ -137,30 +137,6 @@ class _Pending:
         self.channel_id = channel_id
         self.future = future
         self.trace = trace
-
-
-def _start_attempt(fn, args) -> "concurrent.futures.Future":
-    """Run one storage call on its own thread, returning its future.
-
-    A per-attempt thread (not a pool) so a hung backend call can never
-    wedge the slot the NEXT attempt needs; the daemon thread dies with
-    the backend call whenever it finally returns.
-    """
-    f: concurrent.futures.Future = concurrent.futures.Future()
-    # the attempt thread re-enters the flush's trace so a slow backend
-    # call shows up inside the ingest request's span tree, not as an
-    # orphan (record=False: the carried flush span already records)
-    ctx = capture_context()
-
-    def run():
-        try:
-            with carried(ctx, "ingest_flush_attempt", record=False):
-                f.set_result(fn(*args))
-        except BaseException as e:  # noqa: BLE001 — relayed to the waiter
-            f.set_exception(e)
-
-    threading.Thread(target=run, daemon=True, name="pio-ingest-flush").start()
-    return f
 
 
 class WriteBuffer:
@@ -338,14 +314,22 @@ class WriteBuffer:
     def _flush_group(self, events, app_id, channel_id) -> List[str]:
         """insert_batch with bounded retries; attempts after the first go
         through insert_batch_idempotent so an ambiguous failure (backend
-        committed, then the fault fired) cannot duplicate rows."""
-        delay = self.backoff_s
+        committed, then the fault fired) cannot duplicate rows.
+
+        The backoff arithmetic is the shared utils/retry policy; the
+        loop itself stays bespoke because of the hung-flush adoption
+        below (a still-running attempt makes a concurrent retry unsafe
+        on scan-then-write backends — retry_call's abandon-and-retry
+        timeout contract would be wrong here)."""
+        policy = RetryPolicy(retries=self.retries, backoff_s=self.backoff_s,
+                             backoff_cap_s=self.backoff_cap_s)
         last_err: Optional[Exception] = None
-        for attempt in range(self.retries + 1):
+        for attempt in range(policy.attempts()):
             store = self._store_fn()
             fn = (store.insert_batch if attempt == 0
                   else store.insert_batch_idempotent)
-            running = _start_attempt(fn, (events, app_id, channel_id))
+            running = start_attempt_thread(
+                fn, (events, app_id, channel_id), name="pio-ingest-flush")
             try:
                 return running.result(timeout=self.flush_timeout_s)
             # running.done() distinguishes "our wait timed out" from "the
@@ -387,9 +371,8 @@ class WriteBuffer:
                 break
             if self._retry_total is not None:
                 self._retry_total.inc()
-            # exponential backoff with full jitter, capped
-            time.sleep(random.uniform(0, min(self.backoff_cap_s, delay)))
-            delay *= 2
+            # exponential backoff with full jitter, capped (utils/retry)
+            time.sleep(policy.delay_s(attempt))
         raise last_err  # type: ignore[misc]
 
     # -- lifecycle -----------------------------------------------------------

@@ -88,8 +88,15 @@ def record_release(instance: EngineInstance, train_seconds: float,
                   "timeMs": int(_time.time() * 1000),
                   "reason": "train completed"}],
     )
+    from predictionio_tpu.storage.faults import maybe_kill
+
     try:
+        # chaos seam: a kill on either side of the insert is the
+        # "train completed but its manifest may or may not exist" window
+        # the orchestrator's recovery must converge
+        maybe_kill("releases:insert:pre")
         Storage.get_meta_data_releases().insert(release)
+        maybe_kill("releases:insert:committed")
         logger.info("registered release v%d (%s) for %s/%s",
                     release.version, release.id, release.engine_id,
                     release.engine_variant)

@@ -181,13 +181,18 @@ def adopt(name: str, context: Optional[TraceContext] = None,
     """Run a whole job (train, eval, a batchpredict shard) as one trace.
 
     ``context=None`` reads ``PIO_TRACE_CONTEXT`` from the environment —
-    a shard spawned by a parent run joins the parent's trace; a
-    standalone run becomes a root. The job is recorded in the flight
-    recorder on exit either way."""
+    a shard spawned by a parent run joins the parent's trace — and
+    falls back to the ACTIVE trace context: a workflow invoked
+    in-process by a traced parent (an orchestrator cycle running
+    run_train/run_evaluation as phases) joins the parent's trace id
+    instead of starting a fresh root. A standalone run becomes a root.
+    The job is recorded in the flight recorder on exit either way."""
     if context is None:
         from predictionio_tpu.obs.trace_context import from_env
 
         context = from_env()
+        if context is None:
+            context = capture_context()
     with carried(context, name, registry=registry, attrs=attrs) as trace:
         yield trace
 

@@ -174,14 +174,26 @@ async def handle_slo(request):
     raw_targets = request.query.get("targets", "")
     targets = [t.strip() for t in raw_targets.split(",") if t.strip()][:32]
     if targets:
+        from predictionio_tpu.utils.retry import RetryPolicy, \
+            retry_call_async
+
+        # one transient-fault retry with full jitter (the shared
+        # utils/retry policy): a query server mid-restart answers the
+        # fleet view on the second try instead of smearing an "error"
+        # row across the operator's dashboard
+        policy = RetryPolicy(retries=1, backoff_s=0.1, backoff_cap_s=0.5)
         timeout = aiohttp.ClientTimeout(total=5)
         async with aiohttp.ClientSession(timeout=timeout) as session:
 
+            async def _get(target):
+                async with session.get(
+                        f"http://{target}/slo.json") as resp:
+                    return await resp.json()
+
             async def _fetch(target):
                 try:
-                    async with session.get(
-                            f"http://{target}/slo.json") as resp:
-                        return target, await resp.json()
+                    return target, await retry_call_async(
+                        _get, (target,), policy=policy)
                 except Exception as e:
                     return target, {"error": str(e)}
 

@@ -384,12 +384,25 @@ class Releases(abc.ABC):
     def set_status(self, release_id: str, status: str,
                    reason: str = "") -> Optional[Release]:
         """Transition a release's status, appending to its history
-        lineage. Returns the updated release (None when unknown)."""
+        lineage. Returns the updated release (None when unknown).
+
+        Idempotent per status: re-asserting the release's CURRENT status
+        is a no-op (no duplicate history entry, no write) — the
+        orchestrator's crash recovery re-runs half-done transitions, and
+        "promote again" must never record a second promote. Kill points
+        bracket the durable write (``releases:set-status:pre`` /
+        ``releases:set-status:committed``) so chaos tests can die
+        mid-registry-commit on either side of it."""
+        from predictionio_tpu.storage.faults import maybe_kill
+
         if status not in RELEASE_STATUSES:
             raise ValueError(f"unknown release status {status!r}")
         release = self.get(release_id)
         if release is None:
             return None
+        if release.status == status:
+            return release
+        maybe_kill("releases:set-status:pre")
         release.status = status
         release.history = list(release.history) + [{
             "status": status,
@@ -397,6 +410,7 @@ class Releases(abc.ABC):
             "reason": reason,
         }]
         self.update(release)
+        maybe_kill("releases:set-status:committed")
         return release
 
 

@@ -407,6 +407,144 @@ class BatchPredictConfig:
         return cfg
 
 
+@dataclasses.dataclass
+class OrchestratorConfig:
+    """Continuous-training orchestrator tuning (the ``PIO_ORCH_*``
+    knobs; server.json ``orchestrator`` section, camelCase keys; an
+    engine.json top-level ``orchestrator`` section overrides the host
+    file, env overrides both — the established precedence).
+
+    The orchestrator (deploy/orchestrator.py, ``pio orchestrate``) runs
+    the closed train → eval-gate → batchpredict-smoke → canary →
+    promote loop. ``interval_s`` is the trigger-check cadence;
+    ``cooldown_s`` is the minimum gap between one cycle ending and the
+    next trigger firing (the flap-suppression window — a trigger
+    condition that oscillates cannot thrash retrains faster than this).
+    Data-driven triggers: ``min_ingest_events`` fresh events since the
+    last cycle's snapshot watermark (0 disables),
+    ``foldin_pending_max`` fold-in rows pending (0 disables), and
+    ``slo_trigger`` (a burning serving SLO). Each phase runs under
+    ``phase_timeout_s`` with ``phase_retries`` retries backed off with
+    full jitter from ``phase_backoff_s`` (capped at
+    ``phase_backoff_cap_s``); a failed CYCLE backs the next trigger off
+    by a jittered exponential from ``cycle_backoff_s`` (capped at
+    ``cycle_backoff_cap_s``) on top of the cooldown.
+    ``min_eval_score`` gates promotion on the eval sweep's best score
+    (None = no bar); ``smoke_queries`` names a query file for the
+    batchpredict smoke phase (None skips it); ``canary_hold_s`` is how
+    long the registry-plane canary observes the SLO engine before
+    judging, while ``canary_verdict_timeout_s`` bounds how long the
+    HTTP plane waits for a LIVE query server's own canary verdict
+    (sample-count judged — give it time for real traffic) before
+    aborting the rollout. ``state_dir`` holds the crash-safe cycle
+    documents (default ``$PIO_HOME/orchestrator``).
+    """
+
+    interval_s: float = 30.0
+    cooldown_s: float = 300.0
+    min_ingest_events: int = 500
+    foldin_pending_max: int = 0
+    slo_trigger: bool = True
+    phase_timeout_s: float = 3600.0
+    phase_retries: int = 2
+    phase_backoff_s: float = 1.0
+    phase_backoff_cap_s: float = 30.0
+    cycle_backoff_s: float = 60.0
+    cycle_backoff_cap_s: float = 3600.0
+    min_eval_score: Optional[float] = None
+    canary_hold_s: float = 5.0
+    canary_verdict_timeout_s: float = 600.0
+    smoke_queries: Optional[str] = None
+    state_dir: Optional[str] = None
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None,
+                 variant: Optional[dict] = None) -> "OrchestratorConfig":
+        """Per-knob precedence, weakest first: server.json
+        ``orchestrator`` section (``data``) < engine.json
+        ``orchestrator`` section (``variant``) < ``PIO_ORCH_*`` env.
+        Malformed knobs are logged and fall back, same contract as
+        ServingConfig."""
+        data = data or {}
+        variant = variant or {}
+        cfg = cls()
+        as_bool = lambda v: str(v).strip().lower() not in (  # noqa: E731
+            "0", "false", "no", "off", "")
+        file_keys = (
+            ("intervalS", "interval_s", float),
+            ("cooldownS", "cooldown_s", float),
+            ("minIngestEvents", "min_ingest_events", int),
+            ("foldinPendingMax", "foldin_pending_max", int),
+            ("sloTrigger", "slo_trigger", as_bool),
+            ("phaseTimeoutS", "phase_timeout_s", float),
+            ("phaseRetries", "phase_retries", int),
+            ("phaseBackoffS", "phase_backoff_s", float),
+            ("phaseBackoffCapS", "phase_backoff_cap_s", float),
+            ("cycleBackoffS", "cycle_backoff_s", float),
+            ("cycleBackoffCapS", "cycle_backoff_cap_s", float),
+            ("minEvalScore", "min_eval_score", float),
+            ("canaryHoldS", "canary_hold_s", float),
+            ("canaryVerdictTimeoutS", "canary_verdict_timeout_s", float),
+            ("smokeQueries", "smoke_queries", str),
+            ("stateDir", "state_dir", str),
+        )
+        env_keys = (
+            ("PIO_ORCH_INTERVAL_S", "interval_s", float),
+            ("PIO_ORCH_COOLDOWN_S", "cooldown_s", float),
+            ("PIO_ORCH_MIN_INGEST_EVENTS", "min_ingest_events", int),
+            ("PIO_ORCH_FOLDIN_PENDING_MAX", "foldin_pending_max", int),
+            ("PIO_ORCH_SLO_TRIGGER", "slo_trigger", as_bool),
+            ("PIO_ORCH_PHASE_TIMEOUT_S", "phase_timeout_s", float),
+            ("PIO_ORCH_PHASE_RETRIES", "phase_retries", int),
+            ("PIO_ORCH_PHASE_BACKOFF_S", "phase_backoff_s", float),
+            ("PIO_ORCH_PHASE_BACKOFF_CAP_S", "phase_backoff_cap_s", float),
+            ("PIO_ORCH_CYCLE_BACKOFF_S", "cycle_backoff_s", float),
+            ("PIO_ORCH_CYCLE_BACKOFF_CAP_S", "cycle_backoff_cap_s", float),
+            ("PIO_ORCH_MIN_EVAL_SCORE", "min_eval_score", float),
+            ("PIO_ORCH_CANARY_HOLD_S", "canary_hold_s", float),
+            ("PIO_ORCH_CANARY_VERDICT_TIMEOUT_S",
+             "canary_verdict_timeout_s", float),
+            ("PIO_ORCH_SMOKE_QUERIES", "smoke_queries", str),
+            ("PIO_ORCH_STATE_DIR", "state_dir", str),
+        )
+        sources = (
+            [(k, data.get(k), attr, conv) for k, attr, conv in file_keys]
+            + [(f"engine.json {k}", variant.get(k), attr, conv)
+               for k, attr, conv in file_keys]
+            + [(k, os.environ.get(k), attr, conv)
+               for k, attr, conv in env_keys]
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed orchestrator knob %s=%r",
+                               name, raw)
+        cfg.interval_s = max(0.01, cfg.interval_s)
+        cfg.cooldown_s = max(0.0, cfg.cooldown_s)
+        cfg.min_ingest_events = max(0, cfg.min_ingest_events)
+        cfg.foldin_pending_max = max(0, cfg.foldin_pending_max)
+        cfg.phase_timeout_s = max(0.01, cfg.phase_timeout_s)
+        cfg.phase_retries = max(0, cfg.phase_retries)
+        cfg.canary_hold_s = max(0.0, cfg.canary_hold_s)
+        cfg.canary_verdict_timeout_s = max(1.0,
+                                           cfg.canary_verdict_timeout_s)
+        return cfg
+
+
+def orchestrator_config(variant_section: Optional[dict] = None
+                        ) -> OrchestratorConfig:
+    """Resolve the orchestrator knobs a `pio orchestrate` run should
+    use: ``variant_section`` is the engine.json ``orchestrator``
+    section, which overrides the host-level server.json section; the
+    ``PIO_ORCH_*`` env vars override both (the established precedence:
+    env > engine.json > server.json)."""
+    data = read_server_json().get("orchestrator") or {}
+    return OrchestratorConfig.from_env(data, variant_section)
+
+
 def batchpredict_config(variant_section: Optional[dict] = None
                         ) -> BatchPredictConfig:
     """Resolve the batch-scoring knobs a `pio batchpredict` run should
@@ -600,6 +738,8 @@ class ServerConfig:
     foldin: FoldinConfig = dataclasses.field(default_factory=FoldinConfig)
     batchpredict: BatchPredictConfig = dataclasses.field(
         default_factory=BatchPredictConfig)
+    orchestrator: OrchestratorConfig = dataclasses.field(
+        default_factory=OrchestratorConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "ServerConfig":
@@ -618,6 +758,8 @@ class ServerConfig:
             foldin=FoldinConfig.from_env(data.get("foldin") or {}),
             batchpredict=BatchPredictConfig.from_env(
                 data.get("batchpredict") or {}),
+            orchestrator=OrchestratorConfig.from_env(
+                data.get("orchestrator") or {}),
         )
         if os.environ.get("PIO_SERVER_KEY"):
             cfg.key = os.environ["PIO_SERVER_KEY"]

@@ -59,13 +59,23 @@ def run_evaluation(evaluation: Evaluation,
             # recorded INSIDE the adopted trace so the completion event
             # carries the sweep's trace id (the train.py discipline)
             record_event("eval_completed", {"instance": instance_id})
-    except Exception as e:
+    except BaseException as e:
         # a failed sweep must not leave the instance stuck at INIT — the
-        # dashboard/admin listings would show it as forever-starting
-        instance.status = "EVALFAILED"
-        instance.end_time = _dt.datetime.now(tz=UTC)
-        instance.evaluator_results = f"{type(e).__name__}: {e}"
-        instances.update(instance)
+        # dashboard/admin listings would show it as forever-starting.
+        # BaseException on purpose: an injected kill (storage.faults
+        # CrashError) or a KeyboardInterrupt mid-sweep is exactly the
+        # crash the orchestrator's chaos suite drives through here, and
+        # it used to leave the partial INIT row behind. The terminal
+        # write is best-effort (the store may be the thing that died);
+        # the original failure always re-raises.
+        try:
+            instance.status = "EVALFAILED"
+            instance.end_time = _dt.datetime.now(tz=UTC)
+            instance.evaluator_results = f"{type(e).__name__}: {e}"
+            instances.update(instance)
+        except Exception:
+            logger.exception("could not mark instance %s EVALFAILED",
+                             instance_id)
         logger.exception("evaluation failed: instance %s", instance_id)
         raise
 

@@ -187,3 +187,12 @@ class SupplementServing(Serving):
         for p in predictions:
             assert p.q.supp, "serving must see supplemented queries"
         return Prediction(id=-1, q=query, ps=tuple(predictions))
+
+
+def orchestrator_engine():
+    """Factory loadable as ``fake_engine:orchestrator_engine`` from an
+    engine.json — a millisecond-trainable engine for orchestrator CLI
+    smoke tests (the full real-engine cycle is covered separately)."""
+    from predictionio_tpu.core.engine import Engine
+
+    return Engine(DataSource0, Preparator0, {"a": Algo0}, Serving0)

@@ -1,0 +1,185 @@
+"""utils/retry: the one backoff-with-full-jitter implementation.
+
+The write buffer, the admin fleet fan-out and every orchestrator phase
+ride this policy — these tests lock the arithmetic (jitter bounds,
+attempt counts, timeout semantics, BaseException discipline) once, for
+all of them.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.storage.faults import CrashError
+from predictionio_tpu.utils.retry import (
+    RetryPolicy, RetryTimeout, retry_call, retry_call_async,
+)
+
+
+def test_delay_full_jitter_bounds():
+    policy = RetryPolicy(retries=6, backoff_s=0.1, backoff_cap_s=1.0)
+    rng = random.Random(7)
+    for attempt in range(7):
+        ceiling = min(1.0, 0.1 * 2 ** attempt)
+        for _ in range(50):
+            d = policy.delay_s(attempt, rng)
+            assert 0.0 <= d <= ceiling
+    # jitter is actually uniform-ish, not the ceiling constant
+    draws = [policy.delay_s(3, rng) for _ in range(200)]
+    assert min(draws) < 0.2 and max(draws) > 0.6
+
+
+def test_delay_capped_and_zero_base():
+    policy = RetryPolicy(backoff_s=10.0, backoff_cap_s=0.25)
+    assert all(policy.delay_s(a, random.Random(1)) <= 0.25
+               for a in range(8))
+    assert RetryPolicy(backoff_s=0.0).delay_s(5) == 0.0
+
+
+def test_retry_call_succeeds_after_transient_faults():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    out = retry_call(flaky, policy=RetryPolicy(retries=4, backoff_s=0.01),
+                     sleep=sleeps.append, rng=random.Random(0))
+    assert out == "ok"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2          # one backoff per failed attempt
+
+
+def test_retry_call_exhausts_and_raises_last_error():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise ValueError(f"boom {calls['n']}")
+
+    with pytest.raises(ValueError, match="boom 3"):
+        retry_call(always, policy=RetryPolicy(retries=2, backoff_s=0.0),
+                   sleep=lambda s: None)
+    assert calls["n"] == 3           # retries=2 -> 3 attempts
+
+
+def test_retry_call_only_retries_listed_types():
+    def wrong_kind():
+        raise KeyError("not retryable here")
+
+    calls = {"n": 0}
+
+    def count_then_raise():
+        calls["n"] += 1
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):
+        retry_call(count_then_raise,
+                   policy=RetryPolicy(retries=3, backoff_s=0.0),
+                   retry_on=(ValueError,), sleep=lambda s: None)
+    assert calls["n"] == 1
+    with pytest.raises(KeyError):
+        retry_call(wrong_kind, policy=RetryPolicy(retries=3, backoff_s=0.0),
+                   retry_on=(ValueError,), sleep=lambda s: None)
+
+
+def test_retry_call_never_swallows_injected_kills():
+    """CrashError is a BaseException precisely so retry loops cannot
+    absorb it — the shared loop must propagate it on the FIRST attempt."""
+    calls = {"n": 0}
+
+    def killed():
+        calls["n"] += 1
+        raise CrashError("injected kill")
+
+    with pytest.raises(CrashError):
+        retry_call(killed, policy=RetryPolicy(retries=5, backoff_s=0.0),
+                   sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_call_timeout_retries_then_raises():
+    """A hung attempt is abandoned after timeout_s and retried; when
+    every attempt hangs the caller gets RetryTimeout."""
+    release = threading.Event()
+    started = []
+
+    def hangs():
+        started.append(time.monotonic())
+        release.wait(5.0)
+
+    policy = RetryPolicy(retries=1, backoff_s=0.0, timeout_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(RetryTimeout):
+        retry_call(hangs, policy=policy, sleep=lambda s: None)
+    release.set()                    # let the abandoned threads die
+    assert len(started) == 2
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_retry_call_timeout_then_success():
+    calls = {"n": 0}
+
+    def slow_once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            time.sleep(0.3)
+        return calls["n"]
+
+    out = retry_call(slow_once,
+                     policy=RetryPolicy(retries=2, backoff_s=0.0,
+                                        timeout_s=0.05),
+                     sleep=lambda s: None)
+    assert out == 2
+
+
+def test_on_retry_hook_sees_attempt_and_error():
+    seen = []
+
+    def flaky():
+        if len(seen) < 2:
+            raise ValueError("x")
+        return 1
+
+    retry_call(flaky, policy=RetryPolicy(retries=3, backoff_s=0.0),
+               on_retry=lambda a, e: seen.append((a, type(e).__name__)),
+               sleep=lambda s: None)
+    assert seen == [(0, "ValueError"), (1, "ValueError")]
+
+
+@pytest.mark.anyio
+async def test_retry_call_async_retries_and_cancels_on_timeout(
+        anyio_backend):
+    import asyncio
+
+    calls = {"n": 0}
+
+    async def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise ValueError("transient")
+        return "ok"
+
+    out = await retry_call_async(
+        flaky, policy=RetryPolicy(retries=2, backoff_s=0.0))
+    assert out == "ok" and calls["n"] == 2
+
+    cancelled = []
+
+    async def hangs():
+        try:
+            await asyncio.sleep(10)
+        except asyncio.CancelledError:
+            cancelled.append(True)
+            raise
+
+    with pytest.raises(RetryTimeout):
+        await retry_call_async(
+            hangs, policy=RetryPolicy(retries=1, backoff_s=0.0,
+                                      timeout_s=0.05))
+    assert cancelled == [True, True]

@@ -38,6 +38,47 @@ def test_load_file_and_env_overlay(tmp_path, monkeypatch):
     assert ServerConfig.load().key == "envkey"
 
 
+def test_orchestrator_config_precedence(tmp_path, monkeypatch):
+    """PIO_ORCH_* env > engine.json "orchestrator" > server.json, per
+    knob — the established chain, for the orchestrator section."""
+    from predictionio_tpu.utils.server_config import orchestrator_config
+
+    for var in ("PIO_ORCH_INTERVAL_S", "PIO_ORCH_COOLDOWN_S",
+                "PIO_ORCH_MIN_INGEST_EVENTS", "PIO_ORCH_SLO_TRIGGER",
+                "PIO_ORCH_PHASE_RETRIES", "PIO_ORCH_STATE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("PIO_SERVER_CONF", str(tmp_path / "absent.json"))
+    cfg = orchestrator_config(None)
+    assert (cfg.interval_s, cfg.cooldown_s, cfg.min_ingest_events,
+            cfg.slo_trigger, cfg.phase_retries, cfg.min_eval_score,
+            cfg.smoke_queries, cfg.state_dir) == (
+        30.0, 300.0, 500, True, 2, None, None, None)
+
+    conf = tmp_path / "server.json"
+    conf.write_text(json.dumps({"orchestrator": {
+        "intervalS": 5, "cooldownS": 60, "minIngestEvents": 100,
+        "sloTrigger": False, "stateDir": "/tmp/host"}}))
+    monkeypatch.setenv("PIO_SERVER_CONF", str(conf))
+    cfg = orchestrator_config(None)
+    assert (cfg.interval_s, cfg.cooldown_s, cfg.min_ingest_events,
+            cfg.slo_trigger, cfg.state_dir) == (
+        5.0, 60.0, 100, False, "/tmp/host")
+
+    # engine.json section overrides the host file PER KNOB: the
+    # untouched knobs keep the host values
+    cfg = orchestrator_config({"minIngestEvents": 7,
+                               "stateDir": "/tmp/variant"})
+    assert (cfg.interval_s, cfg.min_ingest_events, cfg.state_dir) == (
+        5.0, 7, "/tmp/variant")
+
+    # env beats both; a malformed env knob is logged and ignored
+    monkeypatch.setenv("PIO_ORCH_MIN_INGEST_EVENTS", "42")
+    monkeypatch.setenv("PIO_ORCH_INTERVAL_S", "not-a-number")
+    cfg = orchestrator_config({"minIngestEvents": 7})
+    assert cfg.min_ingest_events == 42
+    assert cfg.interval_s == 5.0       # malformed env fell through
+
+
 def test_check_key():
     cfg = ServerConfig(key="sekrit")
     assert cfg.check_key("sekrit") is True

@@ -2336,6 +2336,196 @@ def cfg_batch_predict(jax, mesh, platform):
     }
 
 
+def cfg_telemetry(jax, mesh, platform):
+    """Durable telemetry (obs/tsdb.py + obs/telemetry.py): the three
+    numbers that decide whether persistence may stay on in production.
+
+    1. SERVING OVERHEAD — p99 at concurrent load with an aggressive
+       scrape loop (50ms interval, ~200x the default cadence) vs
+       PIO_TELEMETRY=0, alternating best-of-N, asserted within
+       BENCH_TELEMETRY_OVERHEAD_PCT (default 5%) + a sub-ms absolute
+       slack — the same discipline as the PR 10 tracing bench.
+    2. WRITE THROUGHPUT — samples/s appending a 10k-series registry
+       snapshot (BENCH_TELEMETRY_SERIES), the store's headline.
+    3. RANGE-QUERY LATENCY — one-metric range read + a fleet
+       quantile-over-time against that 10k-series store, in ms.
+    """
+    import asyncio
+    import tempfile
+
+    import predictionio_tpu.models.als as als_mod
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from predictionio_tpu.core.engine import Engine, TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, RecommendationServing)
+    from predictionio_tpu.models.als import ALSModel
+    from predictionio_tpu.obs.registry import MetricsRegistry
+    from predictionio_tpu.obs.telemetry import TelemetryRecorder
+    from predictionio_tpu.obs.tsdb import TSDBReader
+    from predictionio_tpu.server.query_server import create_query_server
+    from predictionio_tpu.storage.base import EngineInstance
+    from predictionio_tpu.utils.server_config import (
+        ServingConfig, TelemetryConfig)
+
+    nu, ni, rank = 2000, 1000, 16
+    per_level = int(os.environ.get("BENCH_TELEMETRY_QUERIES", 384))
+    n_clients = int(os.environ.get("BENCH_TELEMETRY_CLIENTS", 8))
+    n_series = int(os.environ.get("BENCH_TELEMETRY_SERIES", 10000))
+    ticks = int(os.environ.get("BENCH_TELEMETRY_TICKS", 12))
+    repeats = int(os.environ.get("BENCH_TELEMETRY_REPEATS", 3))
+
+    rng = np.random.default_rng(11)
+    model = ALSModel(
+        user_vocab=np.asarray([f"u{i:06d}" for i in range(nu)],
+                              dtype=object),
+        item_vocab=np.asarray([f"i{i:06d}" for i in range(ni)],
+                              dtype=object),
+        U=rng.normal(size=(nu, rank)).astype(np.float32),
+        V=rng.normal(size=(ni, rank)).astype(np.float32))
+    result = TrainResult(models=[model],
+                         algorithms=[ALSAlgorithm(AlgorithmParams())],
+                         serving=RecommendationServing(),
+                         engine_params=EngineParams())
+    instance = EngineInstance(id="bench-telemetry", engine_id="bench",
+                              engine_variant="default")
+    engine = Engine({}, {}, {"als": ALSAlgorithm}, {})
+
+    async def run_level(c, lat):
+        async def client(k, n):
+            for j in range(n):
+                i = k * n + j
+                t = time.perf_counter()
+                resp = await c.post("/queries.json", json={
+                    "user": f"u{i % nu:06d}", "num": 10})
+                assert resp.status == 200, await resp.text()
+                lat.append(time.perf_counter() - t)
+
+        per_client = max(1, per_level // n_clients)
+        await asyncio.gather(*[client(k, per_client)
+                               for k in range(n_clients)])
+
+    def serve_p99(telemetry) -> float:
+        server = create_query_server(
+            engine, result, instance, None,
+            serving_config=ServingConfig(batch_max=32,
+                                         batch_linger_s=None,
+                                         batch_inflight=2),
+            telemetry=telemetry)
+
+        async def run_all():
+            c = TestClient(TestServer(server.app))
+            await c.start_server()
+            lat = []
+            try:
+                await run_level(c, [])          # warm-up
+                lat.clear()
+                await run_level(c, lat)
+            finally:
+                await c.close()
+            return lat
+
+        lat = asyncio.run(run_all())
+        return round(float(np.percentile(np.asarray(lat) * 1e3, 99)), 3)
+
+    old_rt = als_mod._DEVICE_ROUNDTRIP_S
+    als_mod._DEVICE_ROUNDTRIP_S = 0.0
+    t0 = time.perf_counter()
+    on_p99, off_p99 = [], []
+    try:
+        b = 1
+        while b <= 32:
+            model.recommend_batch([(model.user_vocab[0], 10, (), None)] * b)
+            b <<= 1
+        for r in range(repeats):
+            hb(f"telemetry serve-sweep {r + 1}/{repeats}")
+            off_p99.append(serve_p99(None))
+            root = tempfile.mkdtemp(prefix="bench-telemetry-")
+            cfg = TelemetryConfig(dir=root, interval_s=0.05)
+            rec = TelemetryRecorder("query_server", cfg).start(
+                restore=False)
+            try:
+                on_p99.append(serve_p99(rec))
+            finally:
+                rec.stop()
+    finally:
+        als_mod._DEVICE_ROUNDTRIP_S = old_rt
+    elapsed = time.perf_counter() - t0
+    tel_on, tel_off = min(on_p99), min(off_p99)
+    overhead_pct = (100.0 * (tel_on - tel_off) / tel_off
+                    if tel_off > 0 else 0.0)
+    max_pct = float(os.environ.get("BENCH_TELEMETRY_OVERHEAD_PCT", 5.0))
+    abs_slack_ms = float(os.environ.get(
+        "BENCH_TELEMETRY_OVERHEAD_ABS_MS", 0.3))
+    assert tel_on <= tel_off * (1 + max_pct / 100.0) + abs_slack_ms, (
+        f"telemetry overhead breached: p99 {tel_on}ms with a 50ms "
+        f"scrape loop vs {tel_off}ms telemetry-off "
+        f"(+{overhead_pct:.1f}% > {max_pct}% + {abs_slack_ms}ms)")
+
+    # -- tsdb write throughput at n_series ----------------------------------
+    hb(f"telemetry tsdb-write {n_series} series")
+    reg = MetricsRegistry()
+    wide = reg.counter("pio_bench_wide_total", "bench fanout", ("shard",),
+                       max_series=n_series + 8)
+    lat_hist = reg.histogram("pio_bench_lat_seconds", "bench latency",
+                             ("shard",), buckets=(0.01, 0.1, 1.0),
+                             max_series=1024)
+    for i in range(n_series):
+        wide.inc(float(i % 7 + 1), shard=f"s{i:05d}")
+    root = tempfile.mkdtemp(prefix="bench-tsdb-")
+    store_dir = os.path.join(root, "bench")
+    from predictionio_tpu.obs.tsdb import TSDB
+
+    db = TSDB(store_dir)
+    t0 = time.perf_counter()
+    written = 0
+    for tick in range(ticks):
+        for i in range(0, n_series, 97):
+            wide.inc(1.0, shard=f"s{i:05d}")
+        for i in range(128):
+            lat_hist.observe(0.05 * (i % 3 + 1), shard=f"s{i % 64:05d}")
+        written += db.append_snapshot(reg.to_snapshot(),
+                                      ts_ms=1_700_000_000_000 + 1000 * tick)
+    db.flush()
+    write_s = time.perf_counter() - t0
+    samples_per_s = written / write_s if write_s > 0 else 0.0
+
+    # -- range-query latency over that store --------------------------------
+    hb("telemetry range-query")
+    reader = TSDBReader([store_dir])
+    t0 = time.perf_counter()
+    series = reader.series("pio_bench_lat_seconds")
+    range_ms = 1e3 * (time.perf_counter() - t0)
+    assert series and len(series[0].points) == ticks
+    t0 = time.perf_counter()
+    q99 = reader.quantile_over_time("pio_bench_lat_seconds", 0.99)
+    quantile_ms = 1e3 * (time.perf_counter() - t0)
+    assert q99 is not None
+    rates = reader.rate("pio_bench_wide_total",
+                        labels={"shard": "s00000"})
+    assert rates and rates[0]["increase"] > 0
+
+    return {
+        "elapsed_s": round(elapsed + write_s, 3),
+        "baseline_s": None,
+        "p99_ms_telemetry_on": tel_on,
+        "p99_ms_telemetry_off": tel_off,
+        "telemetry_overhead_pct": round(overhead_pct, 2),
+        "tsdb_series": n_series,
+        "tsdb_samples_written": written,
+        "tsdb_samples_per_s": round(samples_per_s, 1),
+        "range_query_ms": round(range_ms, 2),
+        "quantile_over_time_ms": round(quantile_ms, 2),
+        "note": (f"serving p99 {tel_on}ms w/ 50ms scrape loop vs "
+                 f"{tel_off}ms off ({overhead_pct:+.1f}%, bound "
+                 f"{max_pct}%); tsdb {samples_per_s:,.0f} samples/s at "
+                 f"{n_series} series x {ticks} ticks; range query "
+                 f"{range_ms:.1f}ms, quantile-over-time "
+                 f"{quantile_ms:.1f}ms"),
+    }
+
+
 def cfg_sleep_forever(jax, mesh, platform):
     """Test-only config (never in the default set): wedges the worker so
     the orchestrator's watchdog + ladder can be exercised on CPU."""
@@ -2359,6 +2549,7 @@ CONFIGS = {
     "ingest_write": (cfg_ingest_write, 240),
     "foldin_freshness": (cfg_foldin_freshness, 240),
     "batch_predict": (cfg_batch_predict, 300),
+    "telemetry": (cfg_telemetry, 240),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
 

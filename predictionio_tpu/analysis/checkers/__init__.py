@@ -21,6 +21,9 @@ from predictionio_tpu.analysis.checkers.legacy import (
     EngineRowFind, MetricDocsDrift, StrayPrint,
 )
 from predictionio_tpu.analysis.checkers.locks import BlockingUnderLock
+from predictionio_tpu.analysis.checkers.telemetry import (
+    UncommittedSegmentWrite,
+)
 from predictionio_tpu.analysis.checkers.threads import UncarriedThreadHop
 from predictionio_tpu.analysis.checkers.wire import WireNondeterminism
 
@@ -33,6 +36,7 @@ ALL_CHECKERS = [
     UnregisteredKnobRead,       # PIO006
     TracedNondeterminism,       # PIO007
     WireNondeterminism,         # PIO008
+    UncommittedSegmentWrite,    # PIO009
     StrayPrint,                 # PIO100
     MetricDocsDrift,            # PIO101
     EngineRowFind,              # PIO102

@@ -79,6 +79,7 @@ KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
     "PIO_ORCH_MIN_EVAL_SCORE": (SERVER_CONFIG_PATH,),
     "PIO_ORCH_CANARY_HOLD_S": (SERVER_CONFIG_PATH,),
     "PIO_ORCH_CANARY_VERDICT_TIMEOUT_S": (SERVER_CONFIG_PATH,),
+    "PIO_ORCH_HISTORY_WINDOW_S": (SERVER_CONFIG_PATH,),
     "PIO_ORCH_SMOKE_QUERIES": (SERVER_CONFIG_PATH,),
     "PIO_ORCH_STATE_DIR": (SERVER_CONFIG_PATH,),
 }
@@ -133,6 +134,27 @@ def owner_for(table: Dict[str, Tuple[str, ...]], knob: str
 COMMIT_DOTTED = frozenset({"os.replace", "os.rename"})
 #: method names that commit on a filesystem object (fs.mv(tmp, path))
 COMMIT_ATTRS = frozenset({"mv"})
+
+# -- PIO009: telemetry segment writers ---------------------------------------
+
+#: module -> function names allowed to open segment files for writing.
+#: The durable-telemetry store (obs/tsdb.py) is append-only WITHOUT the
+#: temp-write+rename commit on its hot path — its crash safety rests on
+#: checksummed length-prefixed records plus torn-tail truncation, which
+#: only holds if every byte flows through the helpers that implement
+#: that discipline: `_append_payload` (checksummed append, chaos kill
+#: point inside), `_commit_file` (the temp-write+rename rewrite for
+#: segment roll/compaction), and `_ensure_active` (creates the empty
+#: active file the append helper owns). Any other write in these
+#: modules is a PIO009 finding: route it through the helpers or
+#: register (and justify) it here.
+SEGMENT_WRITE_HELPERS: Dict[str, Tuple[str, ...]] = {
+    "predictionio_tpu/obs/tsdb.py": (
+        "_append_payload", "_commit_file", "_ensure_active"),
+    "predictionio_tpu/obs/telemetry.py": (),
+}
+# (_claim_dir commits the WRITER pid file THROUGH _commit_file, so it
+# needs no entry of its own)
 
 # -- PIO003: trace-plane carriers --------------------------------------------
 

@@ -55,9 +55,14 @@ def status(fleet_path):
 
 def _print_fleet(path):
     """The merged fleet view: per-process counters, exact fleet totals,
-    and the trace ids spanning the run."""
+    and the trace ids spanning the run. A DIRECTORY path is read as a
+    durable-telemetry root instead (obs/fleet.history_reader): the
+    merged per-process tsdb stores, one summary line per series."""
     import os
 
+    if os.path.isdir(path):
+        _print_fleet_history(path)
+        return
     if not path.endswith(".fleet.json") and not os.path.exists(path):
         path = f"{path}.fleet.json"
     elif os.path.isfile(f"{path}.fleet.json"):
@@ -93,6 +98,38 @@ def _print_fleet(path):
         procs = sorted({t.get("process", "?") for t in spans})
         click.echo(f"[INFO] trace {tid}: {len(spans)} span(s) across "
                    f"processes {procs}")
+
+
+def _print_fleet_history(root):
+    """Fleet-wide history summary over a telemetry root: the merged
+    per-process stores (one `process` label per service dir)."""
+    from predictionio_tpu.obs import fleet
+
+    reader = fleet.history_reader(root)
+    by_process = {}
+    for info in reader.series():
+        if not info.points:
+            continue
+        proc = info.labels.get("process", "?")
+        count, newest = by_process.get(proc, (0, 0))
+        by_process[proc] = (count + 1,
+                            max(newest, info.points[-1][0]))
+    if not by_process:
+        click.echo(f"[INFO] No telemetry stores under {root}.")
+        return
+    click.echo(f"[INFO] Telemetry root {root}: "
+               f"{len(by_process)} process store(s)")
+    import datetime as _dt
+
+    for proc, (count, newest) in sorted(by_process.items()):
+        when = _dt.datetime.fromtimestamp(newest / 1000.0)
+        click.echo(f"[INFO]   {proc}: {count} series, newest sample "
+                   f"{when.strftime('%Y-%m-%d %H:%M:%S')}")
+    events = reader.events()[-10:]
+    for _ts, e in events:
+        click.echo(f"[INFO]   event {e.get('kind')} "
+                   f"proc={e.get('process', '?')} "
+                   f"trace={(e.get('traceId') or '-')[:12]}")
 
 
 # ---------------------------------------------------------------------------
@@ -477,8 +514,12 @@ def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
                + (f" (release v{release.version})" if release else "")
                + f" at {ip}:{port}")
     # online fold-in knobs: env > engine.json "foldin" > server.json
-    from predictionio_tpu.utils.server_config import foldin_config
+    from predictionio_tpu.utils.server_config import (
+        foldin_config, telemetry_config,
+    )
     fic = foldin_config((_vj or {}).get("foldin"))
+    # durable telemetry rides the same chain (README "Fleet console")
+    tcfg = telemetry_config((_vj or {}).get("telemetry"))
     if fic.enabled:
         click.echo(f"[INFO] Online fold-in enabled: apply interval "
                    f"{fic.apply_interval_s:g}s, max pending "
@@ -491,7 +532,7 @@ def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
                      feedback=feedback, feedback_app_name=event_server_app,
                      access_key=accesskey, log_url=log_url,
                      log_prefix=log_prefix, release=release,
-                     foldin_config=fic)
+                     foldin_config=fic, telemetry_config=tcfg)
 
 
 def _release_of_instance(engine_id, variant_id, instance_id):
@@ -570,6 +611,19 @@ def rollback(ip, port, accesskey):
                + (f" (release v{version})" if version else ""))
 
 
+def _parse_duration_s(text):
+    """'30m' / '2h' / '45s' / '1d' / plain seconds -> float seconds."""
+    text = str(text).strip().lower()
+    units = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+    try:
+        if text and text[-1] in units:
+            return float(text[:-1]) * units[text[-1]]
+        return float(text)
+    except ValueError:
+        raise click.BadParameter(
+            f"{text!r} is not a duration (try 30m, 2h, 45s)")
+
+
 @cli.command()
 @click.option("--ip", default="localhost")
 @click.option("--port", default=8000, type=int)
@@ -577,12 +631,16 @@ def rollback(ip, port, accesskey):
               help="Only spans of this trace id.")
 @click.option("--limit", type=int, default=20,
               help="Most recent N trace records (default 20).")
+@click.option("--since", "since", default=None, metavar="30m",
+              help="Only records newer than this (e.g. 45s, 30m, 2h) — "
+                   "reaches back through the rings a restart reloaded "
+                   "from the durable telemetry store.")
 @click.option("--events", "show_events", is_flag=True,
               help="Also print lifecycle events (deploys, swaps, "
                    "fold-in applies, canary verdicts, SLO breaches).")
 @click.option("--json", "as_json", is_flag=True,
               help="Raw /debug/traces.json body.")
-def traces(ip, port, trace_id, limit, show_events, as_json):
+def traces(ip, port, trace_id, limit, since, show_events, as_json):
     """Read a live server's flight recorder (GET /debug/traces.json):
     the bounded ring of recent traces + lifecycle events. Works against
     any server in the fleet (event server, query server, admin,
@@ -593,6 +651,8 @@ def traces(ip, port, trace_id, limit, show_events, as_json):
     params = {"limit": str(limit)}
     if trace_id:
         params["traceId"] = trace_id
+    if since:
+        params["sinceS"] = str(_parse_duration_s(since))
     url = (f"http://{ip}:{port}/debug/traces.json?"
            + urllib.parse.urlencode(params))
     try:
@@ -693,11 +753,138 @@ def slo(ip, port):
     click.echo(f"[INFO] SLO status: {state}")
     for obj in doc.get("objectives", []):
         mark = "BREACHED" if obj.get("breached") else "ok"
+        if obj.get("window") == "cold":
+            mark += " (cold: history does not span the window yet)"
         windows = ", ".join(
             f"{int(w['seconds'])}s burn {w['burn']:.2f}/{w['burnThreshold']}"
             for w in obj.get("windows", []))
         click.echo(f"[INFO]   {obj['name']} ({obj['kind']}): {mark} "
                    f"[{windows}]")
+
+
+# ---------------------------------------------------------------------------
+# durable telemetry (obs/tsdb.py + obs/telemetry.py)
+# ---------------------------------------------------------------------------
+
+@cli.group()
+def metrics():
+    """Query the durable local telemetry stores (metrics history that
+    survives restarts; OBSERVABILITY.md "Durable telemetry")."""
+
+
+def _history_reader(dirpath):
+    from predictionio_tpu.obs import fleet
+    from predictionio_tpu.utils.server_config import telemetry_config
+
+    root = dirpath or telemetry_config().root_dir()
+    reader = fleet.history_reader(root)
+    return root, reader
+
+
+@metrics.command("series")
+@click.option("--dir", "dirpath", default=None,
+              help="Telemetry root (default $PIO_HOME/telemetry or "
+                   "PIO_TELEMETRY_DIR).")
+@click.option("--name", default=None, help="Only this metric.")
+def metrics_series(dirpath, name):
+    """List the persisted series: name, labels, sample count, range."""
+    root, reader = _history_reader(dirpath)
+    listing = reader.series(name=name)
+    for info in listing:
+        if not info.points:
+            continue
+        span = (info.points[-1][0] - info.points[0][0]) / 1000.0
+        click.echo(f"[INFO] {info.name} {info.labels} [{info.kind}] "
+                   f"{len(info.points)} sample(s) over {span:.0f}s")
+    click.echo(f"[INFO] {len(listing)} series in {root}.")
+
+
+@metrics.command("query")
+@click.argument("name")
+@click.option("--since", default="1h", metavar="30m",
+              help="Trailing window (e.g. 45s, 30m, 2h, 1d; default 1h).")
+@click.option("--rate", "as_rate", is_flag=True,
+              help="Per-second rate + increase over the window "
+                   "(reset-adjusted: restarts never read negative).")
+@click.option("--quantile", type=float, default=None,
+              help="Histogram quantile over the window, e.g. 0.99.")
+@click.option("--label", "label_filters", multiple=True,
+              metavar="KEY=VALUE", help="Label filter (repeatable).")
+@click.option("--dir", "dirpath", default=None,
+              help="Telemetry root (default $PIO_HOME/telemetry or "
+                   "PIO_TELEMETRY_DIR).")
+@click.option("--json", "as_json", is_flag=True)
+def metrics_query(name, since, as_rate, quantile, label_filters, dirpath,
+                  as_json):
+    """Range-query a metric's persisted history, fleet-merged across
+    every local process's store (each labeled with its `process`)."""
+    import time as _time
+
+    root, reader = _history_reader(dirpath)
+    since_ms = int((_time.time() - _parse_duration_s(since)) * 1000)
+    labels = {}
+    for spec in label_filters:
+        if "=" not in spec:
+            click.echo(f"[ERROR] --label expects KEY=VALUE, got {spec!r}")
+            sys.exit(1)
+        k, v = spec.split("=", 1)
+        labels[k] = v
+    labels = labels or None
+    if quantile is not None:
+        value = reader.quantile_over_time(name, quantile, labels=labels,
+                                          since_ms=since_ms)
+        if as_json:
+            click.echo(json.dumps({"name": name, "quantile": quantile,
+                                   "value": value}))
+        elif value is None:
+            click.echo(f"[INFO] no histogram data for {name} in the "
+                       f"window (root {root}).")
+        else:
+            click.echo(f"[INFO] {name} p{quantile * 100:g} over {since}: "
+                       f"{value:.6g}")
+        return
+    if as_rate:
+        rates = reader.rate(name, labels=labels, since_ms=since_ms)
+        if as_json:
+            click.echo(json.dumps({"name": name, "series": rates}))
+            return
+        for r in rates:
+            click.echo(f"[INFO] {name} {r['labels']}: "
+                       f"{r['rate']:.4g}/s (+{r['increase']:.6g} over "
+                       f"{r['seconds']:.0f}s)")
+        if not rates:
+            click.echo(f"[INFO] no data for {name} in the window "
+                       f"(root {root}).")
+        return
+    series = reader.series(name=name, labels=labels, since_ms=since_ms)
+    if as_json:
+        out = []
+        for info in series:
+            points = ([[ts, sum(c), s] for ts, c, s in info.points]
+                      if info.kind == "histogram"
+                      else [[ts, v] for ts, v in info.points])
+            out.append({"labels": info.labels, "kind": info.kind,
+                        "points": points})
+        click.echo(json.dumps({"name": name, "series": out}))
+        return
+    shown = 0
+    for info in series:
+        if not info.points:
+            continue
+        shown += 1
+        if info.kind == "histogram":
+            first, last = info.points[0], info.points[-1]
+            click.echo(f"[INFO] {name} {info.labels} [histogram]: "
+                       f"count {sum(first[1]):g} -> {sum(last[1]):g} "
+                       f"over {len(info.points)} sample(s)")
+        else:
+            values = [p[1] for p in info.points]
+            click.echo(f"[INFO] {name} {info.labels} [{info.kind}]: "
+                       f"{values[0]:g} -> {values[-1]:g} over "
+                       f"{len(values)} sample(s)")
+    if not shown:
+        click.echo(f"[INFO] no data for {name} in the window "
+                   f"(root {root}).")
 
 
 @cli.command()

@@ -460,15 +460,104 @@ class RegistryPlane:
                                 f"standby restored: {reason}")
 
 
+def _latency_window_start(registry) -> dict:
+    """Snapshot the cumulative serving metrics at canary-hold start, so
+    the verdict can compute the CANDIDATE WINDOW's own p99/error rate
+    as deltas (the live ring only knows 'since process start')."""
+    from predictionio_tpu.obs.registry import Histogram
+
+    out = {"counts": None, "buckets": (), "failures": 0.0}
+    hist = registry.get("pio_query_duration_seconds")
+    if isinstance(hist, Histogram):
+        snap = hist.to_snapshot()
+        counts = [0.0] * (len(hist.buckets) + 1)
+        for s in snap.get("series", ()):
+            for i, c in enumerate(s.get("counts", ())):
+                counts[i] += c
+        out["counts"] = counts
+        out["buckets"] = tuple(hist.buckets)
+    failures = registry.get("pio_query_failures_total")
+    if failures is not None:
+        out["failures"] = sum(v for _, v in failures.samples())
+    return out
+
+
+def _latency_window_stats(registry, start: dict
+                          ) -> Optional[Tuple[float, float, float]]:
+    """(p99_s, error_rate, served) of the window since ``start``; None
+    when the window saw no traffic (nothing to judge)."""
+    from predictionio_tpu.obs.tsdb import bucket_quantile
+
+    end = _latency_window_start(registry)
+    if end["counts"] is None:
+        return None
+    if start["counts"] is None:
+        # the histogram was first registered DURING the hold: the whole
+        # thing is window traffic
+        start = {"counts": [0.0] * len(end["counts"]),
+                 "buckets": end["buckets"],
+                 "failures": start["failures"]}
+    if end["buckets"] != start["buckets"]:
+        return None
+    delta = [max(0.0, b - a) for a, b in zip(start["counts"],
+                                             end["counts"])]
+    served = sum(delta)
+    failures = max(0.0, end["failures"] - start["failures"])
+    if served + failures <= 0:
+        return None
+    p99 = bucket_quantile(end["buckets"], delta, 0.99) if served else 0.0
+    return p99, failures / (served + failures), served
+
+
+def history_baseline(history, window_s: float,
+                     until_ms: Optional[int] = None
+                     ) -> Optional[Tuple[float, float]]:
+    """(p99_s, error_rate) of the trailing ``window_s`` from the durable
+    telemetry store — "was this canary's p99 bad, or is it Tuesday?".
+    None when the store holds no serving history for the window."""
+    until_ms = int(time.time() * 1000) if until_ms is None else until_ms
+    since_ms = int(until_ms - window_s * 1000)
+    p99 = history.quantile_over_time("pio_query_duration_seconds", 0.99,
+                                     since_ms=since_ms, until_ms=until_ms)
+    if p99 is None:
+        return None
+    window = history.histogram_window("pio_query_duration_seconds",
+                                      since_ms=since_ms, until_ms=until_ms)
+    served = window[2] if window is not None else 0.0
+    failures = sum(
+        r["increase"] for r in history.rate("pio_query_failures_total",
+                                            since_ms=since_ms,
+                                            until_ms=until_ms))
+    err_rate = failures / (served + failures) if served + failures > 0 \
+        else 0.0
+    return p99, err_rate
+
+
 def make_slo_judge(slo_engine, hold_s: float,
                    sleep: Callable[[float], None] = time.sleep,
-                   tick_s: float = 0.5) -> Callable:
+                   tick_s: float = 0.5,
+                   history=None,
+                   baseline_window_s: float = 3600.0,
+                   p99_ratio: float = 2.0,
+                   latency_slack_s: float = 0.025,
+                   error_rate_slack: float = 0.05) -> Callable:
     """A registry-plane canary judge over the SLO burn-rate engine:
     hold for ``hold_s``, ticking; any non-freshness breach rolls back,
     a clean hold promotes (freshness excluded for the same reason as
-    fold-in gating: a retrain is the CURE for staleness)."""
+    fold-in gating: a retrain is the CURE for staleness).
+
+    With ``history`` (a tsdb reader over the telemetry stores) and a
+    positive ``baseline_window_s``, the hold window's own p99/error
+    rate is additionally judged against the TRAILING WINDOW from the
+    durable store — not only the incumbent's live ring, which a restart
+    empties: a candidate that is "clean" only because the process
+    forgot what normal looks like still rolls back."""
 
     def judge(doc: CycleDoc) -> Tuple[str, str]:
+        start = None
+        if history is not None and baseline_window_s > 0:
+            start = _latency_window_start(slo_engine.registry)
+            start_ms = int(time.time() * 1000)
         waited = 0.0
         while True:
             slo_engine.tick()
@@ -478,10 +567,33 @@ def make_slo_judge(slo_engine, hold_s: float,
                             if o.get("breached")]
                 return ("rollback", f"slo_burn: {','.join(breached)}")
             if waited >= hold_s:
-                return ("promote", f"slo clean for {hold_s:g}s")
+                break
             step = min(tick_s, hold_s - waited)
             sleep(step)
             waited += step
+        if start is not None:
+            stats = _latency_window_stats(slo_engine.registry, start)
+            baseline = history_baseline(history, baseline_window_s,
+                                        until_ms=start_ms)
+            if stats is not None and baseline is not None:
+                p99, err_rate, served = stats
+                base_p99, base_err = baseline
+                if err_rate > base_err + error_rate_slack:
+                    return ("rollback",
+                            f"history_baseline: window error rate "
+                            f"{err_rate:.3f} > trailing "
+                            f"{base_err:.3f} + {error_rate_slack}")
+                if p99 > base_p99 * p99_ratio + latency_slack_s:
+                    return ("rollback",
+                            f"history_baseline: window p99 "
+                            f"{p99 * 1e3:.1f}ms > trailing p99 "
+                            f"{base_p99 * 1e3:.1f}ms x {p99_ratio} + "
+                            f"{latency_slack_s * 1e3:.0f}ms")
+                return ("promote",
+                        f"slo clean for {hold_s:g}s; window p99 "
+                        f"{p99 * 1e3:.1f}ms / err {err_rate:.3f} within "
+                        f"trailing baseline ({served:.0f} served)")
+        return ("promote", f"slo clean for {hold_s:g}s")
 
     return judge
 
@@ -1261,8 +1373,27 @@ def build_orchestrator(variant_path: str,
         spec = slo_spec_from_server_json()
         if spec is not None:
             slo_engine = SLOEngine(registry or default_registry(), spec)
+        # optional history baseline: the fleet's durable telemetry
+        # stores, when the host runs them (PIO_TELEMETRY=0 or an empty
+        # store degrades to the plain live-ring judgment)
+        history = None
+        if slo_engine is not None and config.history_window_s > 0:
+            from predictionio_tpu.obs import fleet
+            from predictionio_tpu.utils.server_config import (
+                telemetry_config,
+            )
+
+            tcfg = telemetry_config(variant_json.get("telemetry"))
+            if tcfg.enabled:
+                history = fleet.history_reader(tcfg.root_dir())
+                try:
+                    slo_engine.rehydrate(history)
+                except Exception:
+                    logger.exception("orchestrator SLO rehydrate failed")
         plane = RegistryPlane(
-            judge=(make_slo_judge(slo_engine, config.canary_hold_s)
+            judge=(make_slo_judge(
+                slo_engine, config.canary_hold_s, history=history,
+                baseline_window_s=config.history_window_s)
                    if slo_engine is not None else None))
     hooks, engine_id, engine_version, variant_id = build_hooks(
         variant_path, config, eval_path=eval_path, server_get=server_get,

@@ -193,3 +193,38 @@ def import_into_recorder(view: FleetView) -> None:
     flight recorder, so /debug/traces.json on the merger shows the whole
     fleet's spans under one trace id."""
     recorder().import_records(view.traces(), view.events())
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide HISTORY: merging per-process tsdb stores (obs/tsdb.py)
+# ---------------------------------------------------------------------------
+
+def history_dirs(root: str) -> Dict[str, str]:
+    """The per-process telemetry stores under a telemetry root: each
+    service's scrape loop (obs/telemetry.py) owns ``<root>/<service>/``;
+    the subdirectory name becomes the merged view's ``process`` label."""
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return {}
+    return {n: os.path.join(root, n) for n in names
+            if os.path.isdir(os.path.join(root, n))}
+
+
+def history_reader(root_or_dirs):
+    """A fleet-wide :class:`tsdb.TSDBReader`: pass the telemetry root
+    (service stores are discovered and labeled per process) or an
+    explicit ``{process: dir}`` map / dir list. This is what
+    ``pio status --fleet``-style host views, the admin server and the
+    dashboard console read — every server answers range queries for the
+    whole host's history, not just its own store."""
+    from predictionio_tpu.obs.tsdb import TSDBReader
+
+    if isinstance(root_or_dirs, str):
+        dirs = history_dirs(root_or_dirs)
+        if not dirs and os.path.isdir(root_or_dirs):
+            # a bare store directory (single process) works too
+            dirs = {os.path.basename(root_or_dirs.rstrip("/")):
+                    root_or_dirs}
+        return TSDBReader(dirs)
+    return TSDBReader(root_or_dirs)

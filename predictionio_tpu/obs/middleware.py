@@ -161,7 +161,14 @@ def add_metrics_routes(app: web.Application,
                 if "limit" in request.query else None
         except ValueError:
             limit = None
-        return web.json_response(recorder().to_json(trace_id, limit))
+        since_ts = None
+        try:
+            if "sinceS" in request.query:
+                since_ts = time.time() - float(request.query["sinceS"])
+        except ValueError:
+            pass
+        return web.json_response(recorder().to_json(trace_id, limit,
+                                                    since_ts))
 
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/metrics.json", handle_metrics_json)

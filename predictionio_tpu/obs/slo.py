@@ -246,6 +246,11 @@ class SLOEngine:
             o.name: deque(maxlen=ring_len) for o in spec.objectives}
         self._breached: Dict[str, bool] = {o.name: False
                                            for o in spec.objectives}
+        #: per-objective (bad, total) offsets added to the LIVE
+        #: cumulative reads after a rehydrate: the registry re-zeroed at
+        #: restart, but the ring's history is on the pre-restart scale —
+        #: the offsets splice the two into one monotone series
+        self._base: Dict[str, Tuple[float, float]] = {}
         self._last_status: Optional[dict] = None
         self._burn_gauge = registry.gauge(
             "pio_slo_burn_rate",
@@ -263,6 +268,14 @@ class SLOEngine:
 
     # -- cumulative sources --------------------------------------------------
     def _cumulative(self, obj: SLOObjective) -> Tuple[float, float]:
+        bad, total = self._cumulative_raw(obj)
+        base = self._base.get(obj.name)
+        if base is not None:
+            bad += base[0]
+            total += base[1]
+        return bad, total
+
+    def _cumulative_raw(self, obj: SLOObjective) -> Tuple[float, float]:
         fn = self._sources.get(obj.kind)
         if fn is not None:
             return fn(obj)
@@ -337,14 +350,34 @@ class SLOEngine:
             objectives.append({
                 "name": obj.name, "kind": obj.kind,
                 "thresholdS": obj.threshold_s, "budget": obj.budget,
-                "breached": breached, "windows": windows})
+                "breached": breached,
+                "window": self._window_state(ring),
+                "windows": windows})
         status = {
             "breached": any(o["breached"] for o in objectives),
+            #: amnesia honesty: a freshly (re)started engine whose ring
+            #: does not yet span the longest configured window reports
+            #: cold — an empty/healthy evaluation with no history behind
+            #: it must not be mistaken for health (the orchestrator and
+            #: the admin fleet view read this). Rehydration from the
+            #: telemetry store (obs/tsdb.py) flips it warm immediately.
+            "cold": any(o["window"] == "cold" for o in objectives),
             "objectives": objectives,
             "evalIntervalS": self.spec.eval_interval_s,
         }
         self._last_status = status
         return status
+
+    def _window_state(self, ring) -> str:
+        """``warm`` once the ring's covered timespan reaches the longest
+        configured window (rehydration gets there instantly; a cold
+        start earns it by uptime), else ``cold``."""
+        if len(ring) < 2:
+            return "cold"
+        covered = ring[-1][0] - ring[0][0]
+        need = max(w.seconds for w in self.spec.windows) \
+            - 2.0 * self.spec.eval_interval_s
+        return "warm" if covered >= need else "cold"
 
     def _burn(self, ring, now: float, window_s: float, budget: float
               ) -> Tuple[float, float, float]:
@@ -383,3 +416,100 @@ class SLOEngine:
         kinds = {o.name: o.kind for o in self.spec.objectives}
         return any(v and kinds.get(name) not in exclude_kinds
                    for name, v in self._breached.items())
+
+    # -- restart-surviving budgets (the durable-telemetry splice) ------------
+    def rehydrate(self, reader, now: Optional[float] = None,
+                  wall_now: Optional[float] = None) -> int:
+        """Reload the burn-rate rings from the persisted history
+        (obs/tsdb.py via obs/telemetry.py), so error budgets survive a
+        restart: a breach in progress stays breached across a crash
+        loop instead of resetting to amnesia-health.
+
+        Historical wall timestamps are mapped onto the engine's
+        monotonic timescale, and the last historical cumulative value
+        per objective becomes the base offset added to every LIVE read
+        (the restarted registry counts from zero again). Ends with one
+        tick, so ``breached()`` and ``/slo.json`` reflect the restored
+        state immediately. Returns the number of ring samples restored."""
+        now = time.monotonic() if now is None else now
+        wall_now = time.time() if wall_now is None else wall_now
+        max_window = max(w.seconds for w in self.spec.windows)
+        since_ms = int((wall_now - 1.5 * max_window) * 1000)
+        restored = 0
+        for obj in self.spec.objectives:
+            try:
+                pairs = history_cumulative_pairs(reader, obj, since_ms)
+            except Exception:
+                logger.exception("slo rehydrate failed for %s", obj.name)
+                continue
+            if not pairs:
+                continue
+            ring = self._rings[obj.name]
+            for ts_ms, bad, total in pairs:
+                ring.append((now - (wall_now - ts_ms / 1000.0),
+                             bad, total))
+                restored += 1
+            self._base[obj.name] = (pairs[-1][1], pairs[-1][2])
+        if restored:
+            self.tick(now=now)
+            logger.info("SLO rings rehydrated: %d sample(s) across %d "
+                        "objective(s)%s", restored,
+                        len(self.spec.objectives),
+                        " — breach restored" if self.breached() else "")
+        return restored
+
+
+#: the registry metrics each objective kind integrates over (shared by
+#: the live engine and the history rehydration path)
+LATENCY_METRIC = "pio_query_duration_seconds"
+ERRORS_METRIC = "pio_query_failures_total"
+FRESHNESS_METRIC = "pio_foldin_event_to_applied_seconds"
+
+
+def _carry(points: list, t: float) -> float:
+    """Newest scalar cumulative at/before t (0.0 before the first)."""
+    value = 0.0
+    for ts, v in points:
+        if ts > t:
+            break
+        value = v
+    return value
+
+
+def history_cumulative_pairs(reader, obj: SLOObjective,
+                             since_ms: int) -> list:
+    """The objective's ``(ts_ms, bad, total)`` cumulative pairs as the
+    persisted history recorded them — reset-adjusted by the reader, so
+    one series spans any number of process lifetimes (the same math
+    :meth:`SLOEngine._cumulative_raw` does against the live registry)."""
+    import bisect
+
+    if obj.kind == KIND_ERRORS:
+        _, fails = reader.cumulative_series(ERRORS_METRIC,
+                                            since_ms=since_ms)
+        _, served = reader.cumulative_series(LATENCY_METRIC,
+                                             since_ms=since_ms)
+        stamps = sorted({p[0] for p in fails}
+                        | {p[0] for p in served})
+        out = []
+        for t in stamps:
+            bad = _carry(fails, t)
+            good = 0.0
+            for ts, counts, _sum in served:
+                if ts > t:
+                    break
+                good = sum(counts)
+            out.append((t, bad, bad + good))
+        return out
+    metric = LATENCY_METRIC if obj.kind == KIND_LATENCY \
+        else FRESHNESS_METRIC
+    buckets, points = reader.cumulative_series(metric, since_ms=since_ms)
+    if not buckets:
+        return []
+    idx = bisect.bisect_left(list(buckets), obj.threshold_s)
+    out = []
+    for ts, counts, _sum in points:
+        total = sum(counts)
+        below = sum(counts[:idx + 1])
+        out.append((ts, total - below, total))
+    return out

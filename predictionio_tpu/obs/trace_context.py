@@ -123,11 +123,16 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._traces: "deque[dict]" = deque(maxlen=max(1, capacity))
         self._events: "deque[dict]" = deque(maxlen=max(1, event_capacity))
+        #: records EVER appended (rings drop, these only grow) — the
+        #: telemetry loop's incremental-persistence cursors ride them
+        self._trace_count = 0
+        self._event_count = 0
 
     # -- traces --------------------------------------------------------------
     def record_trace(self, record: dict) -> None:
         with self._lock:
             self._traces.append(record)
+            self._trace_count += 1
 
     def record_span(self, *, trace_id: str, span_id: str,
                     parent_span_id: Optional[str], name: str,
@@ -168,25 +173,49 @@ class FlightRecorder:
                   "traceId": trace_id, "process": _process_label()}
         with self._lock:
             self._events.append(record)
+            self._event_count += 1
         return record
 
     # -- readout -------------------------------------------------------------
     def traces(self, trace_id: Optional[str] = None,
-               limit: Optional[int] = None) -> List[dict]:
+               limit: Optional[int] = None,
+               since_ts: Optional[float] = None) -> List[dict]:
         with self._lock:
             out = list(self._traces)
         if trace_id is not None:
             out = [t for t in out if t.get("traceId") == trace_id]
+        if since_ts is not None:
+            out = [t for t in out if t.get("ts", 0) >= since_ts]
         if limit is not None:
             out = out[-limit:]
         return out
 
-    def events(self, limit: Optional[int] = None) -> List[dict]:
+    def events(self, limit: Optional[int] = None,
+               since_ts: Optional[float] = None) -> List[dict]:
         with self._lock:
             out = list(self._events)
+        if since_ts is not None:
+            out = [e for e in out if e.get("ts", 0) >= since_ts]
         if limit is not None:
             out = out[-limit:]
         return out
+
+    def tail(self, trace_cursor: int, event_cursor: int
+             ) -> "tuple[List[dict], List[dict], int, int]":
+        """Records appended since the given cursors (the running
+        append counts a previous :meth:`tail` returned) — the telemetry
+        loop's incremental persistence read. Records that already fell
+        off a ring before the read are gone (the ring IS the bound);
+        returns (new_traces, new_events, trace_cursor', event_cursor')."""
+        with self._lock:
+            t_total, e_total = self._trace_count, self._event_count
+            new_t = (list(self._traces)[-min(t_total - trace_cursor,
+                                             len(self._traces)):]
+                     if t_total > trace_cursor else [])
+            new_e = (list(self._events)[-min(e_total - event_cursor,
+                                             len(self._events)):]
+                     if e_total > event_cursor else [])
+        return new_t, new_e, t_total, e_total
 
     def import_records(self, traces: List[dict], events: List[dict],
                        process: Optional[str] = None) -> None:
@@ -199,16 +228,19 @@ class FlightRecorder:
                 if process is not None:
                     entry.setdefault("process", process)
                 self._traces.append(entry)
+                self._trace_count += 1
             for e in events or ():
                 entry = dict(e)
                 if process is not None:
                     entry.setdefault("process", process)
                 self._events.append(entry)
+                self._event_count += 1
 
     def to_json(self, trace_id: Optional[str] = None,
-                limit: Optional[int] = None) -> dict:
-        return {"traces": self.traces(trace_id, limit),
-                "events": self.events(limit)}
+                limit: Optional[int] = None,
+                since_ts: Optional[float] = None) -> dict:
+        return {"traces": self.traces(trace_id, limit, since_ts),
+                "events": self.events(limit, since_ts)}
 
     def clear(self) -> None:
         with self._lock:

@@ -207,7 +207,13 @@ async def handle_slo(request):
     return web.json_response(out)
 
 
-def create_admin_server(registry: MetricsRegistry = None) -> web.Application:
+def create_admin_server(registry: MetricsRegistry = None,
+                        telemetry=None,
+                        history_root: str = None) -> web.Application:
+    from predictionio_tpu.obs.telemetry import (
+        add_history_routes, history_reader_factory,
+    )
+
     registry = registry or MetricsRegistry()
     app = web.Application(middlewares=[
         observability_middleware(registry, "admin")])
@@ -219,15 +225,33 @@ def create_admin_server(registry: MetricsRegistry = None) -> web.Application:
     app.router.add_get("/cmd/releases", handle_releases)
     app.router.add_get("/cmd/slo", handle_slo)
     add_metrics_routes(app, registry, default_registry())
+    # fleet-wide history: the admin answers /history/*.json over the
+    # MERGED per-process telemetry stores (obs/fleet.history_reader) —
+    # the operator's one endpoint for longitudinal questions
+    add_history_routes(app, history_reader_factory(telemetry,
+                                                   root=history_root))
+    if telemetry is not None:
+        async def _stop_telemetry(app):
+            import asyncio
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, telemetry.stop)
+        app.on_shutdown.append(_stop_telemetry)
     return app
 
 
 def run_admin_server(ip: str = "localhost", port: int = DEFAULT_PORT) -> None:
+    from predictionio_tpu.obs.telemetry import build_recorder
     from predictionio_tpu.utils.server_config import ServerConfig
 
     cfg = ServerConfig.load()
+    registry = MetricsRegistry()
+    telemetry = build_recorder("admin", cfg.telemetry,
+                               instance=str(port),
+                               registries=[registry, default_registry()])
     ssl_ctx = cfg.ssl_context()
     logger.info("Admin API listening on %s:%s%s", ip, port,
                 " (TLS)" if ssl_ctx else "")
-    web.run_app(create_admin_server(), host=ip, port=port,
-                ssl_context=ssl_ctx, print=None)
+    web.run_app(create_admin_server(registry, telemetry=telemetry,
+                                    history_root=cfg.telemetry.root_dir()),
+                host=ip, port=port, ssl_context=ssl_ctx, print=None)

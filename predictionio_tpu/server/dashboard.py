@@ -1,18 +1,36 @@
-"""Evaluation dashboard — port 9000.
+"""Fleet console — port 9000.
 
-Parity with the reference Dashboard (tools/.../dashboard/Dashboard.scala:45-162):
-an HTML index of completed EvaluationInstances (newest first) with per-instance
-detail pages rendering the stored evaluator HTML, plus JSON endpoints for
-programmatic access. Optional key auth + TLS come from the server config
-(the reference's with-key-auth SSL dashboard, Dashboard.scala:65+ /
-KeyAuthentication.scala:33-62).
+Grown from the reference's evaluation dashboard
+(tools/.../dashboard/Dashboard.scala:45-162 — an HTML index of completed
+EvaluationInstances, still served here route-for-route) into the
+operator's live console over the durable-telemetry plane:
+
+  GET /                      -> the console: releases + lineage, SLO burn
+                                tables with sparkline history, the
+                                orchestrator cycle timeline, top device
+                                dispatch families, recent traces and
+                                lifecycle events, completed evaluations
+  GET /history/series.json   -> persisted series inventory (fleet-wide)
+  GET /history/range.json    -> raw samples / rate() / quantile-over-time
+  GET /engine_instances/<id> -> evaluation detail (reference parity)
+  GET /evaluations.json, /evaluations/<id>.json -> JSON parity endpoints
+
+Everything longitudinal renders from the merged per-process telemetry
+stores (obs/fleet.history_reader over the telemetry root) — no script
+tags, no external assets: sparklines are unicode blocks, so the console
+works over curl and in an airgap. Optional key auth + TLS come from the
+server config; the metrics/history endpoints stay unauthenticated like
+every other server's.
 """
 
 from __future__ import annotations
 
 import html
+import json
 import logging
-from typing import Optional
+import os
+import time
+from typing import List, Optional
 
 from aiohttp import web
 
@@ -20,6 +38,10 @@ from predictionio_tpu.obs.middleware import (
     METRICS_PATHS, add_metrics_routes, observability_middleware,
 )
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
+from predictionio_tpu.obs.telemetry import (
+    HISTORY_PATHS, add_history_routes, history_reader_factory,
+)
+from predictionio_tpu.obs.trace_context import recorder
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.utils.server_config import ServerConfig
 
@@ -28,37 +50,308 @@ logger = logging.getLogger("pio.dashboard")
 DEFAULT_PORT = 9000
 
 _SERVER_CONFIG = web.AppKey("server_config", ServerConfig)
+_READER_FACTORY = web.AppKey("history_reader_factory", object)
+_ORCH_STATE_DIR = web.AppKey("orch_state_dir", str)
+
+#: unicode sparkline ramp (8 levels)
+_SPARK = "▁▂▃▄▅▆▇█"
 
 
 @web.middleware
 async def _key_auth_middleware(request, handler):
-    if request.path in METRICS_PATHS:  # scrapers hold no access keys
-        return await handler(request)
+    if request.path in METRICS_PATHS or request.path in HISTORY_PATHS:
+        return await handler(request)   # scrapers hold no access keys
     cfg = request.app[_SERVER_CONFIG]
     if not cfg.check_key(request.query.get("accessKey")):
         return web.json_response({"message": "Unauthorized"}, status=401)
     return await handler(request)
 
 
-def _index_html(instances) -> str:
-    rows = "".join(
-        f"<tr><td><a href='/engine_instances/{html.escape(i.id)}'>"
-        f"{html.escape(i.id)}</a></td>"
-        f"<td>{html.escape(i.evaluation_class)}</td>"
-        f"<td>{i.start_time.isoformat()}</td>"
-        f"<td>{i.end_time.isoformat()}</td>"
-        f"<td>{html.escape(i.evaluator_results)}</td></tr>"
-        for i in instances)
-    return (
-        "<html><head><title>predictionio_tpu dashboard</title></head><body>"
-        "<h1>Completed evaluations</h1>"
-        "<table border=1><tr><th>ID</th><th>Evaluation</th><th>Started</th>"
-        f"<th>Finished</th><th>Result</th></tr>{rows}</table></body></html>")
+def sparkline(values: List[float], width: int = 32) -> str:
+    """Server-rendered history: the last ``width`` values as unicode
+    blocks, scaled to their own max (flat-zero renders as floor)."""
+    values = [float(v) for v in values][-width:]
+    if not values:
+        return ""
+    top = max(values)
+    if top <= 0:
+        return _SPARK[0] * len(values)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int(v / top * (len(_SPARK) - 1)))]
+        for v in values)
 
+
+def _series_sparkline(info, rate: bool = False) -> str:
+    """A SeriesInfo's values as a sparkline; cumulative kinds (and all
+    histograms, via their total count) plot per-interval increases."""
+    if info.kind == "histogram":
+        values = [sum(p[1]) for p in info.points]
+        rate = True
+    else:
+        values = [p[1] for p in info.points]
+    if rate and len(values) >= 2:
+        values = [max(0.0, b - a) for a, b in zip(values, values[1:])]
+    return sparkline(values)
+
+
+def _esc(value) -> str:
+    return html.escape(str(value))
+
+
+# ---------------------------------------------------------------------------
+# console sections (each degrades to an honest "no data" row)
+# ---------------------------------------------------------------------------
+
+def _section(title: str, body: str) -> str:
+    return f"<h2>{_esc(title)}</h2>\n{body}\n"
+
+
+def _table(headers: List[str], rows: List[List[str]],
+           empty: str = "no data") -> str:
+    if not rows:
+        return f"<p><em>{_esc(empty)}</em></p>"
+    head = "".join(f"<th>{h}</th>" for h in headers)
+    body = "".join("<tr>" + "".join(f"<td>{c}</td>" for c in row) + "</tr>"
+                   for row in rows)
+    return f"<table border=1 cellpadding=4><tr>{head}</tr>{body}</table>"
+
+
+def _releases_rows() -> List[List[str]]:
+    try:
+        releases = Storage.get_meta_data_releases().get_all()
+    except Exception:
+        return []
+    rows = []
+    for r in sorted(releases, key=lambda r: (r.engine_id,
+                                             r.engine_variant, -r.version)):
+        lineage = " → ".join(h.get("status", "?") for h in r.history) \
+            or r.status
+        rows.append([
+            _esc(f"{r.engine_id.rsplit('.', 1)[-1]}/{r.engine_variant}"),
+            f"v{r.version}",
+            f"<b>{_esc(r.status)}</b>",
+            _esc(r.instance_id),
+            _esc(r.created_time.strftime("%Y-%m-%d %H:%M:%S")),
+            _esc(lineage)])
+    return rows
+
+
+def _slo_rows(reader, since_ms: int) -> List[List[str]]:
+    rows = []
+    breached = {}
+    for info in reader.series("pio_slo_breached", since_ms=since_ms):
+        key = (info.labels.get("process", ""),
+               info.labels.get("objective", ""))
+        breached[key] = info.points[-1][1] if info.points else 0.0
+    for info in reader.series("pio_slo_burn_rate", since_ms=since_ms):
+        if not info.points:
+            continue
+        process = info.labels.get("process", "")
+        objective = info.labels.get("objective", "")
+        state = "BREACHED" if breached.get((process, objective)) else "ok"
+        rows.append([
+            _esc(process), _esc(objective),
+            _esc(info.labels.get("window", "")),
+            f"{info.points[-1][1]:.2f}",
+            f"<b>{state}</b>" if state == "BREACHED" else state,
+            f"<code>{_series_sparkline(info)}</code>"])
+    return rows
+
+
+def _cycle_rows(state_dir: Optional[str], limit: int = 12
+                ) -> List[List[str]]:
+    """The orchestrator cycle timeline from its crash-safe history dir
+    (deploy/orchestrator.CycleStore archives one JSON per cycle)."""
+    if not state_dir:
+        return []
+    history = os.path.join(state_dir, "history")
+    try:
+        names = sorted(os.listdir(history))
+    except OSError:
+        return []
+    docs = []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(history, name)) as f:
+                docs.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    docs.sort(key=lambda d: d.get("started_ms", 0), reverse=True)
+    rows = []
+    for d in docs[:limit]:
+        started = time.strftime(
+            "%Y-%m-%d %H:%M:%S",
+            time.localtime(d.get("started_ms", 0) / 1000.0))
+        wall = (d.get("updated_ms", 0) - d.get("started_ms", 0)) / 1000.0
+        outcome = d.get("outcome", "?")
+        mark = f"<b>{_esc(outcome)}</b>" if outcome != "promoted" \
+            else _esc(outcome)
+        rows.append([
+            _esc(d.get("cycle_id", "?")), _esc(d.get("trigger", "?")),
+            _esc(started), f"{wall:.1f}s", _esc(d.get("phase", "")),
+            mark,
+            _esc((f"v{d['candidate_release_version']}"
+                  if d.get("candidate_release_version") else "-")),
+            _esc((d.get("reason") or "")[:80])])
+    return rows
+
+
+def _dispatch_rows(reader, since_ms: int, top: int = 10
+                   ) -> List[List[str]]:
+    rates = reader.rate("pio_device_dispatch_seconds_total",
+                        since_ms=since_ms)
+    rates.sort(key=lambda r: -r["increase"])
+    return [[_esc(r["labels"].get("family", "?")),
+             _esc(r["labels"].get("process", "")),
+             f"{r['increase']:.3f}s",
+             f"{100.0 * r['rate']:.2f}%"]
+            for r in rates[:top]]
+
+
+def _trace_rows(reader, since_ms: int, limit: int = 12) -> List[List[str]]:
+    local = recorder().traces(limit=limit)
+    persisted = [t for _ts, t in reader.traces(since_ms=since_ms)]
+    seen, rows = set(), []
+    for t in (persisted + local)[-4 * limit:]:
+        key = (t.get("traceId"), t.get("spanId"))
+        if key in seen:
+            continue
+        seen.add(key)
+        rows.append(t)
+    rows.sort(key=lambda t: t.get("ts", 0), reverse=True)
+    return [[_esc((t.get("traceId") or "?")[:12]),
+             _esc(t.get("name", "?")),
+             f"{1e3 * t.get('durationSec', 0.0):.1f}ms",
+             _esc(t.get("status", "?")),
+             _esc(t.get("process", ""))]
+            for t in rows[:limit]]
+
+
+def _event_rows(reader, since_ms: int, limit: int = 12) -> List[List[str]]:
+    local = recorder().events(limit=limit)
+    persisted = [e for _ts, e in reader.events(since_ms=since_ms)]
+    seen, rows = set(), []
+    for e in persisted + local:
+        key = (e.get("ts"), e.get("kind"), e.get("traceId"))
+        if key in seen:
+            continue
+        seen.add(key)
+        rows.append(e)
+    rows.sort(key=lambda e: e.get("ts", 0), reverse=True)
+    out = []
+    for e in rows[:limit]:
+        detail = {k: v for k, v in e.items()
+                  if k not in ("kind", "ts", "traceId", "process")}
+        out.append([
+            _esc(time.strftime("%H:%M:%S",
+                               time.localtime(e.get("ts", 0)))),
+            _esc(e.get("kind", "?")),
+            _esc((e.get("traceId") or "-")[:12]),
+            _esc(e.get("process", "")),
+            _esc(json.dumps(detail, sort_keys=True)[:100])])
+    return out
+
+
+def _serving_rows(reader, since_ms: int) -> List[List[str]]:
+    rows = []
+    for info in reader.series("pio_query_duration_seconds",
+                              since_ms=since_ms):
+        if info.kind != "histogram" or not info.points:
+            continue
+        rows.append([
+            _esc(info.labels.get("process", "")),
+            _esc(info.labels.get("engine_variant", "")),
+            f"{sum(info.points[-1][1]):.0f}",
+            f"<code>{_series_sparkline(info)}</code>"])
+    if rows:
+        q99 = reader.quantile_over_time("pio_query_duration_seconds",
+                                        0.99, since_ms=since_ms)
+        rows[0].append(f"{1e3 * q99:.1f}ms" if q99 is not None else "")
+        for row in rows[1:]:
+            row.append("")
+    return rows
+
+
+def _evaluation_rows() -> List[List[str]]:
+    try:
+        instances = \
+            Storage.get_meta_data_evaluation_instances().get_completed()
+    except Exception:
+        return []
+    return [[
+        f"<a href='/engine_instances/{_esc(i.id)}'>{_esc(i.id)}</a>",
+        _esc(i.evaluation_class),
+        _esc(i.start_time.isoformat()),
+        _esc(i.end_time.isoformat()),
+        _esc(i.evaluator_results)] for i in instances]
+
+
+def render_console(reader, orch_state_dir: Optional[str],
+                   window_s: float = 3600.0) -> str:
+    since_ms = int((time.time() - window_s) * 1000)
+    sections = [
+        _section("Releases", _table(
+            ["engine/variant", "version", "status", "instance", "created",
+             "lineage"], _releases_rows(),
+            empty="no releases registered")),
+        _section("SLO burn (trailing hour)", _table(
+            ["process", "objective", "window", "burn now", "state",
+             "history"], _slo_rows(reader, since_ms),
+            empty="no persisted SLO history — is telemetry enabled on "
+                  "the query server?")),
+        _section("Serving (trailing hour)", _table(
+            ["process", "variant", "queries", "throughput history",
+             "p99 over window"], _serving_rows(reader, since_ms),
+            empty="no persisted serving history")),
+        _section("Orchestrator cycles", _table(
+            ["cycle", "trigger", "started", "wall", "last phase",
+             "outcome", "release", "reason"],
+            _cycle_rows(orch_state_dir),
+            empty="no archived cycles (pio orchestrate writes them)")),
+        _section("Top dispatch families (trailing hour)", _table(
+            ["family", "process", "device seconds", "duty"],
+            _dispatch_rows(reader, since_ms),
+            empty="no dispatch attribution persisted")),
+        _section("Recent traces", _table(
+            ["trace", "name", "wall", "status", "process"],
+            _trace_rows(reader, since_ms), empty="no traces recorded")),
+        _section("Lifecycle events", _table(
+            ["at", "kind", "trace", "process", "detail"],
+            _event_rows(reader, since_ms), empty="no lifecycle events")),
+        _section("Completed evaluations", _table(
+            ["ID", "Evaluation", "Started", "Finished", "Result"],
+            _evaluation_rows(), empty="no completed evaluations")),
+    ]
+    return (
+        "<html><head><title>predictionio_tpu fleet console</title>"
+        "<style>body{font-family:monospace;margin:24px}"
+        "table{border-collapse:collapse;margin-bottom:12px}"
+        "td,th{text-align:left}code{font-size:14px}</style></head><body>"
+        "<h1>predictionio_tpu fleet console</h1>"
+        "<p>JSON: <a href='/history/series.json'>/history/series.json</a>"
+        " · /history/range.json?name=&lt;metric&gt;&amp;sinceS=3600"
+        "[&amp;rate=1|&amp;quantile=0.99] · "
+        "<a href='/metrics'>/metrics</a> · "
+        "<a href='/debug/traces.json'>/debug/traces.json</a></p>"
+        + "".join(sections) + "</body></html>")
+
+
+# ---------------------------------------------------------------------------
+# handlers
+# ---------------------------------------------------------------------------
 
 async def handle_index(request):
-    instances = Storage.get_meta_data_evaluation_instances().get_completed()
-    return web.Response(text=_index_html(instances), content_type="text/html")
+    import asyncio
+
+    # the render reads (and CRC-checks) every telemetry segment plus
+    # storage tables — synchronous by nature, so it runs off the event
+    # loop; a slow console page must never stall concurrent requests
+    reader = request.app[_READER_FACTORY]()
+    page = await asyncio.get_running_loop().run_in_executor(
+        None, render_console, reader, request.app.get(_ORCH_STATE_DIR))
+    return web.Response(text=page, content_type="text/html")
 
 
 async def handle_detail(request):
@@ -97,26 +390,52 @@ async def handle_detail_json(request):
 
 
 def create_dashboard(server_config: Optional[ServerConfig] = None,
-                     registry: Optional[MetricsRegistry] = None
+                     registry: Optional[MetricsRegistry] = None,
+                     telemetry=None,
+                     history_root: Optional[str] = None,
+                     orch_state_dir: Optional[str] = None
                      ) -> web.Application:
     registry = registry or MetricsRegistry()
     app = web.Application(middlewares=[
         observability_middleware(registry, "dashboard"),
         _key_auth_middleware])
     app[_SERVER_CONFIG] = server_config or ServerConfig()
+    app[_READER_FACTORY] = history_reader_factory(telemetry,
+                                                  root=history_root)
+    if orch_state_dir:
+        app[_ORCH_STATE_DIR] = orch_state_dir
     app.router.add_get("/", handle_index)
     app.router.add_get("/engine_instances/{instance_id}", handle_detail)
     app.router.add_get("/evaluations.json", handle_index_json)
     app.router.add_get("/evaluations/{instance_id}.json", handle_detail_json)
     add_metrics_routes(app, registry, default_registry())
+    add_history_routes(app, app[_READER_FACTORY])
+    if telemetry is not None:
+        async def _stop_telemetry(app):
+            import asyncio
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, telemetry.stop)
+        app.on_shutdown.append(_stop_telemetry)
     return app
 
 
 def run_dashboard(ip: str = "localhost", port: int = DEFAULT_PORT,
                   server_config: Optional[ServerConfig] = None) -> None:
+    from predictionio_tpu.deploy.orchestrator import default_state_dir
+    from predictionio_tpu.obs.telemetry import build_recorder
+
     cfg = server_config or ServerConfig.load()
+    registry = MetricsRegistry()
+    telemetry = build_recorder("dashboard", cfg.telemetry,
+                               instance=str(port),
+                               registries=[registry, default_registry()])
     ssl_ctx = cfg.ssl_context()
-    logger.info("Dashboard listening on %s:%s%s", ip, port,
+    logger.info("Fleet console listening on %s:%s%s", ip, port,
                 " (TLS)" if ssl_ctx else "")
-    web.run_app(create_dashboard(cfg), host=ip, port=port,
-                ssl_context=ssl_ctx, print=None)
+    web.run_app(
+        create_dashboard(cfg, registry, telemetry=telemetry,
+                         history_root=cfg.telemetry.root_dir(),
+                         orch_state_dir=(cfg.orchestrator.state_dir
+                                         or default_state_dir())),
+        host=ip, port=port, ssl_context=ssl_ctx, print=None)

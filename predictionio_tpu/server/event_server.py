@@ -70,10 +70,15 @@ class EventServer:
     def __init__(self, stats: bool = False,
                  plugin_context: Optional[PluginContext] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 ingest: Optional[IngestConfig] = None):
+                 ingest: Optional[IngestConfig] = None,
+                 telemetry=None):
         self.stats_enabled = stats
         self.registry = registry or MetricsRegistry()
         self.ingest_config = ingest or IngestConfig.from_env()
+        #: durable-telemetry recorder (obs/telemetry.py) when wired by
+        #: run_event_server: ingest metrics + lifecycle events survive
+        #: the process, /history/* serves the host's merged stores
+        self.telemetry = telemetry
         self.buffer: Optional[WriteBuffer] = None
         if self.ingest_config.buffer:
             ic = self.ingest_config
@@ -104,10 +109,15 @@ class EventServer:
 
     async def _drain_on_shutdown(self, app) -> None:
         """Graceful shutdown: flush every buffered event before the
-        process exits — accepted (201-pending) events are never dropped."""
+        process exits — accepted (201-pending) events are never dropped;
+        the telemetry recorder then drains its final snapshot + the
+        flight-recorder remainder into the durable store."""
         if self.buffer is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.buffer.stop)
+        if self.telemetry is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.telemetry.stop)
 
     # -- auth ---------------------------------------------------------------
     async def _auth(self, request: web.Request) -> AuthData:
@@ -163,6 +173,11 @@ class EventServer:
         r.add_post("/webhooks/{name}.json", self.handle_webhook_post)
         r.add_get("/webhooks/{name}.json", self.handle_webhook_get)
         add_metrics_routes(self.app, self.registry, default_registry())
+        from predictionio_tpu.obs.telemetry import (
+            add_history_routes, history_reader_factory,
+        )
+
+        add_history_routes(self.app, history_reader_factory(self.telemetry))
 
     def _ingest(self, status: int, reason: Optional[str] = None) -> None:
         self._ingest_total.inc(status=str(status))
@@ -437,20 +452,27 @@ class EventServer:
 def create_event_server(stats: bool = False,
                         plugin_context: Optional[PluginContext] = None,
                         registry: Optional[MetricsRegistry] = None,
-                        ingest: Optional[IngestConfig] = None
-                        ) -> web.Application:
+                        ingest: Optional[IngestConfig] = None,
+                        telemetry=None) -> web.Application:
     """EventServer.createEventServer:528 parity."""
     return EventServer(stats=stats, plugin_context=plugin_context,
-                       registry=registry, ingest=ingest).app
+                       registry=registry, ingest=ingest,
+                       telemetry=telemetry).app
 
 
 def run_event_server(ip: str = "localhost", port: int = DEFAULT_PORT,
                      stats: bool = False) -> None:
     """Standalone entry (EventServer Run.main:552)."""
+    from predictionio_tpu.obs.telemetry import build_recorder
     from predictionio_tpu.utils.server_config import ServerConfig
 
     cfg = ServerConfig.load()
-    app = create_event_server(stats=stats, ingest=cfg.ingest)
+    registry = MetricsRegistry()
+    telemetry = build_recorder("event_server", cfg.telemetry,
+                               instance=str(port),
+                               registries=[registry, default_registry()])
+    app = create_event_server(stats=stats, ingest=cfg.ingest,
+                              registry=registry, telemetry=telemetry)
     ssl_ctx = cfg.ssl_context()
     logger.info("Event Server listening on %s:%s%s", ip, port,
                 " (TLS)" if ssl_ctx else "")

@@ -392,7 +392,8 @@ class QueryServer:
                  deploy_config: Optional[DeployConfig] = None,
                  release: Optional[Release] = None,
                  foldin_config: Optional[FoldinConfig] = None,
-                 slo_spec: Optional[SLOSpec] = None):
+                 slo_spec: Optional[SLOSpec] = None,
+                 telemetry=None):
         self.engine = engine
         self.feedback = feedback
         self.feedback_app_name = feedback_app_name
@@ -434,6 +435,13 @@ class QueryServer:
         #: predict slot of the incumbent
         self._deploy_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="pio-deploy")
+        #: release-lineage writes are best-effort AND ordered: a single
+        #: worker preserves submission order, so a canary's CANARY write
+        #: and the operator rollback's ROLLED_BACK that follows it can
+        #: never commit inverted (observed as a release stuck at CANARY
+        #: when both rode the shared default executor)
+        self._lineage_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pio-lineage")
         #: pre-resolved span-histogram handle for batch-stage timings
         #: (_predict_batch runs per batch on the executor — it must not
         #: take the registry lock to re-resolve the histogram each stage)
@@ -488,6 +496,17 @@ class QueryServer:
         self._slo = (SLOEngine(self.registry, slo_spec)
                      if slo_spec is not None else None)
         self._slo_task: Optional[asyncio.Task] = None
+        #: durable-telemetry recorder (obs/telemetry.py), owned by this
+        #: server when given: scrape loop persists the registry + flight
+        #: recorder, /history/* serves the host's merged stores, and the
+        #: SLO rings REHYDRATE from history so an error budget burned
+        #: before a restart stays burned (breach-in-progress survives)
+        self._telemetry = telemetry
+        if self._telemetry is not None and self._slo is not None:
+            try:
+                self._slo.rehydrate(self._telemetry.reader())
+            except Exception:
+                logger.exception("SLO rehydration from history failed")
         self.app = web.Application(middlewares=[
             observability_middleware(self.registry, "query_server")])
         self.app.on_startup.append(self._on_startup_foldin)
@@ -564,6 +583,16 @@ class QueryServer:
                 await unit.batcher.shutdown()
         self._predict_executor.shutdown(wait=False)
         self._deploy_executor.shutdown(wait=False)
+        # lineage writes drain: the last status transition of a shutdown
+        # (a rollback's ROLLED_BACK) must land before the process exits
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._lineage_executor.shutdown(wait=True))
+        if self._telemetry is not None:
+            # LAST: the final drain must include the flight-recorder
+            # records the steps above just emitted (fold-in close,
+            # batcher retirement, the lineage lane's terminal writes)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._telemetry.stop)
 
     def _routes(self):
         r = self.app.router
@@ -579,6 +608,12 @@ class QueryServer:
         r.add_get("/slo.json", self.handle_slo)
         r.add_post("/debug/profile", self.handle_profile)
         add_metrics_routes(self.app, self.registry, default_registry())
+        from predictionio_tpu.obs.telemetry import (
+            add_history_routes, history_reader_factory,
+        )
+
+        add_history_routes(self.app,
+                           history_reader_factory(self._telemetry))
 
     # -- serving-unit plumbing (deploy/ subsystem) ---------------------------
     @property
@@ -1114,20 +1149,24 @@ class QueryServer:
     def _set_release_status(self, release: Optional[Release], status: str,
                             reason: str) -> None:
         """Best-effort lineage write-back (off-thread; a registry outage
-        must never wedge serving)."""
+        must never wedge serving), ordered by the single lineage lane."""
         if release is None:
             return
+        ctx = capture_context()
 
         def _write():
-            try:
-                Storage.get_meta_data_releases().set_status(
-                    release.id, status, reason=reason)
-            except Exception:
-                logger.exception("release status update failed (%s -> %s)",
-                                 release.id, status)
+            with carried(ctx, "release_status", record=False):
+                try:
+                    Storage.get_meta_data_releases().set_status(
+                        release.id, status, reason=reason)
+                except Exception:
+                    logger.exception(
+                        "release status update failed (%s -> %s)",
+                        release.id, status)
         release.status = status          # keep the resident copy honest
         try:
-            asyncio.get_running_loop().run_in_executor(None, _write)
+            asyncio.get_running_loop()
+            self._lineage_executor.submit(_write)
         except RuntimeError:             # no loop (tests calling directly)
             _write()
 
@@ -1554,6 +1593,17 @@ def run_query_server(engine: Engine, train_result: TrainResult,
     from predictionio_tpu.obs.slo import slo_spec_from_server_json
 
     kwargs.setdefault("slo_spec", slo_spec_from_server_json())
+    # durable telemetry: scrape loop + history surface + SLO rehydration
+    # (env > engine.json "telemetry" > server.json; PIO_TELEMETRY=0 off;
+    # pio deploy passes the engine.json-aware config explicitly)
+    tcfg = kwargs.pop("telemetry_config", None) or cfg.telemetry
+    if "telemetry" not in kwargs:
+        from predictionio_tpu.obs.telemetry import build_recorder
+
+        registry = kwargs.setdefault("registry", MetricsRegistry())
+        kwargs["telemetry"] = build_recorder(
+            "query_server", tcfg, instance=str(port),
+            registries=[registry, default_registry()])
     server = create_query_server(engine, train_result, instance, ctx, **kwargs)
     ssl_ctx = cfg.ssl_context()
     logger.info("Query server listening on %s:%s%s", ip, port,

@@ -1,0 +1,73 @@
+"""Child process for the SLO-restart e2e (not a test module).
+
+Runs a hermetic query server (synthetic ALS factors, no storage, no
+training) with an SLO spec and a durable-telemetry recorder pointed at
+the directory in argv — the real `pio deploy` wiring in miniature. The
+parent burns the error budget over HTTP, SIGKILLs this process, starts
+a second copy against the SAME telemetry dir, and asserts /slo.json
+still shows the breach (obs/slo.SLOEngine.rehydrate).
+
+Usage: python telemetry_child.py <port> <telemetry_root>
+"""
+
+import sys
+
+
+def main():
+    port, root = int(sys.argv[1]), sys.argv[2]
+
+    import numpy as np
+    from aiohttp import web
+
+    from predictionio_tpu.core.engine import Engine, TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.engines.recommendation import (
+        ALSAlgorithm, AlgorithmParams, RecommendationServing,
+    )
+    from predictionio_tpu.models.als import ALSModel
+    from predictionio_tpu.obs.registry import (
+        MetricsRegistry, default_registry,
+    )
+    from predictionio_tpu.obs.slo import (
+        SLOObjective, SLOSpec, SLOWindow,
+    )
+    from predictionio_tpu.obs.telemetry import TelemetryRecorder
+    from predictionio_tpu.server.query_server import create_query_server
+    from predictionio_tpu.storage.base import EngineInstance
+    from predictionio_tpu.utils.server_config import (
+        ServingConfig, TelemetryConfig,
+    )
+
+    rng = np.random.default_rng(7)
+    nu, ni, rank = 30, 20, 4
+    model = ALSModel(
+        user_vocab=np.asarray([f"u{i}" for i in range(nu)], dtype=object),
+        item_vocab=np.asarray([f"i{i}" for i in range(ni)], dtype=object),
+        U=rng.normal(size=(nu, rank)).astype(np.float32),
+        V=rng.normal(size=(ni, rank)).astype(np.float32))
+    result = TrainResult(
+        models=[model], algorithms=[ALSAlgorithm(AlgorithmParams())],
+        serving=RecommendationServing(), engine_params=EngineParams())
+    instance = EngineInstance(id="slo-restart-e2e", engine_id="bench",
+                              engine_variant="default")
+    # the window must comfortably outlive two jax cold-starts on a
+    # loaded CI box — a breach that AGES OUT of a short window across
+    # the restart is correct behavior, not survival
+    spec = SLOSpec(
+        objectives=[SLOObjective("errors", "errors", budget=0.05)],
+        windows=[SLOWindow(1800.0, 2.0)],
+        eval_interval_s=0.1)
+    cfg = TelemetryConfig(dir=root, interval_s=0.1)
+    registry = MetricsRegistry()
+    telemetry = TelemetryRecorder(
+        "query_server", cfg,
+        registries=[registry, default_registry()]).start()
+    server = create_query_server(
+        Engine({}, {}, {"als": ALSAlgorithm}, {}), result, instance, None,
+        serving_config=ServingConfig(batch_max=8, batch_linger_s=0.0),
+        registry=registry, slo_spec=spec, telemetry=telemetry)
+    web.run_app(server.app, host="127.0.0.1", port=port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -547,9 +547,12 @@ class ErrorALS(ALSAlgorithm):
         raise RuntimeError("regressed model")
 
 
-async def _wait_release_status(release_id, status, timeout=3.0):
+async def _wait_release_status(release_id, status, timeout=15.0):
     """Release lineage writes are scheduled off the serving path; poll
-    the store instead of racing them."""
+    the store instead of racing them. The deadline is generous slack
+    only — a passing write returns at the next 20ms poll; a loaded
+    2-core CI box has been seen delaying the default-executor write
+    past 3s."""
     deadline = time.monotonic() + timeout
     rels = Storage.get_meta_data_releases()
     while time.monotonic() < deadline:

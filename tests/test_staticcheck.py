@@ -513,6 +513,74 @@ def test_pio008_sorted_sets_and_non_wire_modules_pass():
 
 
 # ---------------------------------------------------------------------------
+# PIO009 — telemetry segment writers ride the committed-write helpers
+# ---------------------------------------------------------------------------
+
+def test_pio009_flags_segment_write_outside_the_helpers():
+    r = check_src("""
+        import os
+
+        class TSDB:
+            def _commit_file(self, name, records):
+                tmp = name + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(records)
+                os.replace(tmp, name)
+
+            def quick_fix(self, path, buf):
+                with open(path, "ab") as f:   # bypasses the framing
+                    f.write(buf)
+    """, path="predictionio_tpu/obs/tsdb.py", rules=["PIO009"])
+    assert rules_of(r) == ["PIO009"]
+    assert "quick_fix" in r.findings[0].message
+
+
+def test_pio009_registered_helpers_and_other_modules_pass():
+    code = """
+        import os
+
+        class TSDB:
+            def _ensure_active(self, path):
+                self._f = open(path, "ab")
+
+            def _append_payload(self, buf):
+                self._f.write(buf)
+
+            def _commit_file(self, name, records):
+                tmp = name + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(records)
+                os.replace(tmp, name)
+    """
+    r = check_src(code, path="predictionio_tpu/obs/tsdb.py",
+                  rules=["PIO009"])
+    assert rules_of(r) == []
+    # the rule is scoped: the same write elsewhere is not its business
+    # (PIO002 owns the general commit discipline)
+    r = check_src("""
+        def write(path, buf):
+            with open(path, "ab") as f:
+                f.write(buf)
+    """, path="predictionio_tpu/models/mod.py", rules=["PIO009"])
+    assert rules_of(r) == []
+
+
+def test_pio009_helper_registry_matches_the_real_module(repo_project):
+    """Rot guard: every registered committed-write helper exists in the
+    module it is registered for (a rename would silently un-protect
+    the store)."""
+    paths = {f.path: f for f in repo_project.files}
+    for path, helpers in reg.SEGMENT_WRITE_HELPERS.items():
+        f = paths.get(path)
+        assert f is not None, f"SEGMENT_WRITE_HELPERS names missing {path}"
+        names = {i.name for i in repo_project.functions.infos
+                 if i.file is f}
+        for helper in helpers:
+            assert helper in names, (
+                f"{path}: registered helper {helper} does not exist")
+
+
+# ---------------------------------------------------------------------------
 # PIO100/PIO101/PIO102 — the ported legacy gates
 # ---------------------------------------------------------------------------
 
@@ -699,8 +767,8 @@ def test_unknown_rule_is_an_error():
 def test_all_rules_inventory():
     rules = all_rules()
     expected = {"PIO001", "PIO002", "PIO003", "PIO004", "PIO005",
-                "PIO006", "PIO007", "PIO008", "PIO090", "PIO100",
-                "PIO101", "PIO102"}
+                "PIO006", "PIO007", "PIO008", "PIO009", "PIO090",
+                "PIO100", "PIO101", "PIO102"}
     assert set(rules) == expected
     assert all(rules.values()), "every rule carries a title"
 

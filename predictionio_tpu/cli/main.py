@@ -515,11 +515,25 @@ def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
                + f" at {ip}:{port}")
     # online fold-in knobs: env > engine.json "foldin" > server.json
     from predictionio_tpu.utils.server_config import (
-        foldin_config, telemetry_config,
+        foldin_config, scorer_config, telemetry_config,
     )
     fic = foldin_config((_vj or {}).get("foldin"))
     # durable telemetry rides the same chain (README "Fleet console")
     tcfg = telemetry_config((_vj or {}).get("telemetry"))
+    # scoring-kernel knobs ride the same chain (README "Scoring kernel");
+    # echoed like the ALS-solver line so the operator sees what the box
+    # will actually serve with
+    scfg = scorer_config((_vj or {}).get("scorer"))
+    if scfg.mode == "exact":
+        click.echo("[INFO] Scoring kernel exact (fused modes via "
+                   'engine.json {"scorer": {"mode": ...}} or '
+                   "PIO_SCORER_MODE)")
+    else:
+        click.echo(f"[INFO] Scoring kernel {scfg.mode} (tile "
+                   f"{scfg.tile_items} items"
+                   + (f", shortlist {scfg.shortlist}"
+                      if scfg.mode == "twostage" else "")
+                   + f", parity floor recall@10 >= {scfg.min_recall:g})")
     if fic.enabled:
         click.echo(f"[INFO] Online fold-in enabled: apply interval "
                    f"{fic.apply_interval_s:g}s, max pending "
@@ -532,7 +546,8 @@ def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
                      feedback=feedback, feedback_app_name=event_server_app,
                      access_key=accesskey, log_url=log_url,
                      log_prefix=log_prefix, release=release,
-                     foldin_config=fic, telemetry_config=tcfg)
+                     foldin_config=fic, scorer_config=scfg,
+                     telemetry_config=tcfg)
 
 
 def _release_of_instance(engine_id, variant_id, instance_id):
@@ -1135,6 +1150,16 @@ def batchpredict(variant, input_path, output_path, engine_instance_id,
     engine, _, factory_path, variant_id, variant_json = \
         _load_engine_variant(variant)
     variant_conf = variant_json.get("batchpredict")
+    # offline scoring honors the same scorer-mode chain as serving, so
+    # batchpredict parity runs compare like against like
+    from predictionio_tpu.ops.scoring import set_process_scorer_config
+    from predictionio_tpu.utils.server_config import scorer_config
+
+    scfg = scorer_config(variant_json.get("scorer"))
+    set_process_scorer_config(scfg)
+    if scfg.mode != "exact":
+        click.echo(f"[INFO] Scoring kernel {scfg.mode} (tile "
+                   f"{scfg.tile_items} items)")
     instances = Storage.get_meta_data_engine_instances()
     if release_selector:
         release = resolve_release(Storage.get_meta_data_releases(),

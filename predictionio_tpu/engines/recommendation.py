@@ -412,6 +412,15 @@ class ALSAlgorithm(Algorithm):
         resident = getattr(model, "_resident", None)
         if resident is not None:
             new._resident = resident
+        # same discipline for the quantized scorer residency
+        # (ops/scoring): keyed on V identity, so a user-only fold keeps
+        # the quantized copy while an item fold REQUANTIZES the updated
+        # rows on the next scored batch — which is the fold-in
+        # controller's pre-swap warm drive, keeping the rebuild off the
+        # serving path
+        scorer_cache = getattr(model, "_scorer_cache", None)
+        if scorer_cache is not None:
+            new._scorer_cache = scorer_cache
         return new
 
     #: device metric kinds `sweep_eval` can compute

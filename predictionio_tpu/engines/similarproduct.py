@@ -167,6 +167,11 @@ class SimilarityModel:
     V: np.ndarray                     # [n_items, K] row-normalized
     items: Dict[int, Item]
 
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_scorer_cache", None)  # quantized residency never persists
+        return d
+
     def item_index(self, item_id: str) -> Optional[int]:
         return vocab_index(self.item_vocab, item_id)
 
@@ -268,10 +273,17 @@ class ALSAlgorithm(Algorithm):
 
     def batch_predict(self, model: SimilarityModel, queries):
         """Vectorized batch scorer (the query-server micro-batch path):
-        B summed-cosine matvecs collapse into one [B, K] @ [K, N] BLAS
+        B summed-cosine matvecs collapse into one [B, K] @ [K, N]
         matmul; per-query candidate filtering stays on host. The server
         hands this a bucketed, padded batch (ops/bucketing), so B is
-        already shape-stable."""
+        already shape-stable.
+
+        Under a non-exact scorer mode (ops/scoring) the matmul +
+        top-k rides the fused streaming kernel instead of materializing
+        [B, N] host scores — eligible whenever no query carries the
+        unbounded filters (categories / whiteList), whose rejection
+        count a top-k fetch cannot bound; those queries keep the exact
+        full-score path."""
         idx_sets = []
         for _, q in queries:
             idx_sets.append({i for i in (model.item_index(x)
@@ -282,12 +294,69 @@ class ALSAlgorithm(Algorithm):
             return out
         qsums = np.stack([model.V[sorted(idx_sets[b])].sum(axis=0)
                           for b in rows])
+        fused = self._fused_batch(model, queries, rows, idx_sets, qsums)
+        if fused is not None:
+            for b, res in zip(rows, fused):
+                out[b] = (queries[b][0], res)
+            return out
         scores = qsums @ model.V.T                       # [B, N] host BLAS
         for r, b in enumerate(rows):
             i, q = queries[b]
             out[b] = (i, _score_and_filter(model, scores[r], q,
                                            idx_sets[b]))
         return out
+
+    def _fused_batch(self, model: SimilarityModel, queries, rows,
+                     idx_sets, qsums):
+        """Score `rows` through the fused top-k kernel, or None when the
+        batch is ineligible (exact mode, parity-demoted scorer, or a
+        query whose filters need full scores). Query-item and blacklist
+        exclusions are BOUNDED (at most len(items)+len(blackList) of the
+        top hits can be rejected), so fetching top-(num + bound) and
+        filtering on host reproduces `_score_and_filter` exactly —
+        including its stop-at-nonpositive-score rule."""
+        from predictionio_tpu.ops import scoring
+
+        if scoring.process_scorer_config().mode == "exact":
+            return None
+        extra = 0
+        want_max = 0
+        for b in rows:
+            q = queries[b][1]
+            if q.categories is not None or q.white_list is not None:
+                return None
+            extra = max(extra,
+                        len(idx_sets[b]) + len(q.black_list or ()))
+            want_max = max(want_max, q.num)
+        scorer = scoring.scorer_for(model, model.V)
+        if scorer is None or not scorer.active:
+            return None
+        n_items = len(model.item_vocab)
+        k = min(want_max + extra, n_items)
+        scores, idx = scorer.topk(qsums, k)
+        results = []
+        for r, b in enumerate(rows):
+            q = queries[b][1]
+            black = {i for i in (model.item_index(x)
+                                 for x in (q.black_list or ()))
+                     if i is not None}
+            picked = []
+            for t in range(idx.shape[1]):
+                s = float(scores[r, t])
+                if not np.isfinite(s) or s <= 0:
+                    break
+                i = int(idx[r, t])
+                # the ONE candidate-rule definition `_score_and_filter`
+                # uses — the fused and exact lanes cannot drift
+                if not _candidate_ok(i, model.items, idx_sets[b], q,
+                                     None, black):
+                    continue
+                picked.append(ItemScore(item=str(model.item_vocab[i]),
+                                        score=s))
+                if len(picked) >= q.num:
+                    break
+            results.append(PredictedResult(item_scores=picked))
+        return results
 
 
 class LikeAlgorithm(ALSAlgorithm):

@@ -1071,13 +1071,23 @@ def _topk_scores_batch_nomask(user_vecs: jax.Array, V: jax.Array,
 #: sends the batch wherever it finishes sooner (dispatch-latency-aware
 #: serving — the design answer to BENCH_r03's 137ms query p50, where the
 #: reference's in-heap serial loop CreateServer.scala:508-510 pays zero
-#: dispatch cost).
+#: dispatch cost). Re-probed when the scorer MODE changes: a stale
+#: measurement taken under a different kernel regime would mis-route
+#: batches for the rest of the process. Tests/benches that FORCE the
+#: device lane assign ``_DEVICE_ROUNDTRIP_S = 0.0`` directly (leaving
+#: the mode marker alone), which pins the value across modes.
 _DEVICE_ROUNDTRIP_S: Optional[float] = None
+_DEVICE_ROUNDTRIP_MODE: Optional[str] = None
 
 
 def device_roundtrip_s() -> float:
-    global _DEVICE_ROUNDTRIP_S
-    if _DEVICE_ROUNDTRIP_S is None:
+    global _DEVICE_ROUNDTRIP_S, _DEVICE_ROUNDTRIP_MODE
+    from predictionio_tpu.ops.scoring import process_scorer_config
+
+    mode = process_scorer_config().mode
+    if _DEVICE_ROUNDTRIP_S is None or (
+            _DEVICE_ROUNDTRIP_MODE is not None
+            and _DEVICE_ROUNDTRIP_MODE != mode):
         import time
 
         # pio: ignore[PIO001]: one-shot roundtrip probe; result memoized in _DEVICE_ROUNDTRIP_S
@@ -1088,6 +1098,7 @@ def device_roundtrip_s() -> float:
         for _ in range(3):
             jax.device_get(probe(x))
         _DEVICE_ROUNDTRIP_S = (time.perf_counter() - t0) / 3
+        _DEVICE_ROUNDTRIP_MODE = mode
     return _DEVICE_ROUNDTRIP_S
 
 
@@ -1126,6 +1137,7 @@ class ALSModel:
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_resident", None)      # device arrays never hit the checkpoint
+        d.pop("_scorer_cache", None)  # quantized residency rebuilds on load
         return d
 
     @property
@@ -1179,11 +1191,35 @@ class ALSModel:
         round-trip is ~10-100ms, so small catalogs (ML-100K: 1682 x 10)
         always serve from host; catalogs where the [B,N]@[N,K] matmul
         dominates go to the MXU. Masked batches lean host-ward because the
-        device path also pays the [B, n_items] mask transfer."""
+        device path also pays the [B, n_items] mask transfer.
+
+        Host BLAS materializes full f32 scores, i.e. it IS the exact
+        scorer — so it only competes in exact mode. A non-exact scorer
+        mode (ops/scoring) always routes device: the operator chose
+        quantized residency for a catalog scale where the host crossover
+        is irrelevant, and splitting a fused deployment's traffic across
+        an exact host lane would make answers depend on batch size."""
+        from predictionio_tpu.ops.scoring import process_scorer_config
+
+        if process_scorer_config().mode != "exact":
+            return False
         flops = 2.0 * n_rows * len(self.item_vocab) * self.U.shape[1]
         host_s = flops / _host_flops()
         device_s = device_roundtrip_s() * (1.5 if any_mask else 1.0)
         return host_s < device_s
+
+    def _fused_scorer(self):
+        """The cached ops/scoring scorer for the CURRENT process scorer
+        mode, or None when exact (or when the built scorer's parity
+        gate demoted it to exact). Keyed on V's identity like
+        `V_device`, so a fold-in apply that swaps V requantizes on the
+        next scored batch — the pre-swap warm drive in practice."""
+        from predictionio_tpu.ops import scoring
+
+        scorer = scoring.scorer_for(self, self.V)
+        if scorer is None or not scorer.active:
+            return None
+        return scorer
 
     def recommend_batch(self, requests):
         """Batched recommend: one [B,K]@[K,N] matmul + top_k for B queries.
@@ -1271,6 +1307,17 @@ class ALSModel:
                     m = self._query_mask(requests[j][2], requests[j][3])
                     scores[b, m] = -np.inf
             scores, idx = _host_topk(scores, k)
+        elif (scorer := self._fused_scorer()) is not None:
+            # fused/quantized/two-stage streaming kernel (ops/scoring):
+            # the [B, n_items] score matrix never materializes, and the
+            # seen-items mask folds into the tiles as a -inf sentinel,
+            # so masked and unmasked batches ride ONE kernel family
+            mask = None
+            if any_mask:
+                mask = np.stack(
+                    [self._query_mask(requests[j][2], requests[j][3])
+                     for j in rows])
+            scores, idx = scorer.topk(u_batch, k, mask=mask)
         else:
             # bucket B and k to powers of two (ops/bucketing — the rule
             # the serving micro-batcher shares) so this scorer compiles a

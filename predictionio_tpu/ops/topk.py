@@ -16,15 +16,22 @@ import numpy as np
 def host_topk(scores: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
     """Per-row descending top-k: [B, N] -> (values [B, k], idx [B, k]).
 
-    k is clamped to N. argpartition + argsort of the k-prefix, the
-    O(N + k log k) idiom numpy lacks a primitive for.
+    k is clamped to N. argpartition against the (n-k)th element + a
+    descending sort of the k-suffix — the O(N + k log k) idiom numpy
+    lacks a primitive for, WITHOUT materializing a negated [B, N] copy:
+    when k << N the only full-width pass is the partition itself, and
+    the negation (numpy sorts ascending) touches just the [B, k] slice.
     """
     n = scores.shape[1]
     k = min(k, n)
+    if k <= 0:
+        empty = np.zeros((scores.shape[0], 0))
+        return empty.astype(scores.dtype), empty.astype(np.int64)
     if k >= n:
         idx = np.argsort(-scores, axis=1)
     else:
-        part = np.argpartition(-scores, k, axis=1)[:, :k]
-        order = np.argsort(-np.take_along_axis(scores, part, axis=1), axis=1)
+        part = np.argpartition(scores, n - k, axis=1)[:, n - k:]
+        order = np.argsort(-np.take_along_axis(scores, part, axis=1),
+                           axis=1)
         idx = np.take_along_axis(part, order, axis=1)
     return np.take_along_axis(scores, idx, axis=1), idx

@@ -79,7 +79,7 @@ from predictionio_tpu.server.plugins import PluginContext
 from predictionio_tpu.storage.base import EngineInstance, Release, generate_id
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.utils.server_config import (
-    DeployConfig, FoldinConfig, ServingConfig,
+    DeployConfig, FoldinConfig, ScorerConfig, ServingConfig,
 )
 
 logger = logging.getLogger("pio.queryserver")
@@ -392,6 +392,7 @@ class QueryServer:
                  deploy_config: Optional[DeployConfig] = None,
                  release: Optional[Release] = None,
                  foldin_config: Optional[FoldinConfig] = None,
+                 scorer_config: Optional[ScorerConfig] = None,
                  slo_spec: Optional[SLOSpec] = None,
                  telemetry=None):
         self.engine = engine
@@ -419,6 +420,15 @@ class QueryServer:
         self.serving_config = serving_config or ServingConfig.from_env()
         self.deploy_config = deploy_config or DeployConfig.from_env()
         self.foldin_config = foldin_config or FoldinConfig.from_env()
+        #: resolved scoring-kernel knobs (env > engine.json "scorer" >
+        #: server.json — pio deploy passes the engine.json-aware config
+        #: explicitly). Pinned process-wide so every scoring surface the
+        #: serving units reach (models, warm-up, fold-in drives) sees
+        #: ONE mode; /deploy/status.json echoes it per unit.
+        from predictionio_tpu.ops import scoring as _scoring
+
+        self.scorer_config = scorer_config or ScorerConfig.from_env()
+        _scoring.set_process_scorer_config(self.scorer_config)
         #: online fold-in controller (deploy/foldin.py), started on the
         #: server loop when enabled AND the engine supports it
         self._foldin = None
@@ -1486,7 +1496,22 @@ class QueryServer:
             "foldin": (self._foldin.status_dict()
                        if self._foldin is not None
                        else {"enabled": False}),
+            "scorer": self._scorer_status(),
         })
+
+    def _scorer_status(self) -> dict:
+        """Resolved scorer mode + per-unit quantized residency (the
+        pio deploy echo's live counterpart, mirroring the ALS-solver
+        echo). ``units`` is empty until a unit's first device-scored
+        batch builds its scorer — warm-up does that on warmed deploys."""
+        from predictionio_tpu.ops import scoring
+
+        return {
+            "mode": self.scorer_config.mode,
+            "tileItems": self.scorer_config.tile_items,
+            "shortlist": self.scorer_config.shortlist,
+            "units": scoring.unit_scorer_status(self._unit.result),
+        }
 
     async def handle_stop(self, request):
         if not self._authorized(request):
@@ -1589,6 +1614,9 @@ def run_query_server(engine: Engine, train_result: TrainResult,
     # online fold-in knobs from server.json "foldin" + PIO_FOLDIN_* env
     # (pio deploy passes an engine.json-aware config explicitly)
     kwargs.setdefault("foldin_config", cfg.foldin)
+    # scoring-kernel knobs from server.json "scorer" + PIO_SCORER_* env
+    # (pio deploy passes an engine.json-aware config explicitly)
+    kwargs.setdefault("scorer_config", cfg.scorer)
     # per-release SLO objectives from server.json "slo" (PIO_SLO=0 off)
     from predictionio_tpu.obs.slo import slo_spec_from_server_json
 

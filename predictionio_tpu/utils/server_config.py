@@ -20,6 +20,8 @@ Config file: ``$PIO_CONF_DIR/server.json`` (or the path in
      "ingest": {"maxEventsPerBatch": 50, "buffer": true, "queueMax": 8192,
                 "flushMax": 256, "lingerS": 0.002, "retries": 4},
      "train": {"alsSolver": "subspace", "alsBlockSize": 16},
+     "scorer": {"mode": "exact", "tileItems": 16384, "shortlist": 512,
+                "minRecall": 0.99},
      "foldin": {"enabled": false, "applyIntervalS": 2.0,
                 "maxPending": 1024},
      "batchpredict": {"chunkSize": 1024, "queueChunks": 4,
@@ -312,6 +314,98 @@ class FoldinConfig:
         cfg.max_pending = max(1, cfg.max_pending)
         cfg.row_len = max(1, cfg.row_len)
         return cfg
+
+
+@dataclasses.dataclass
+class ScorerConfig:
+    """Top-k scoring-kernel selection (the ``PIO_SCORER_*`` knobs;
+    server.json ``scorer`` section, camelCase keys; an engine.json
+    top-level ``scorer`` section overrides the host file, env overrides
+    both — the established precedence).
+
+    ``mode`` picks the kernel every ALS-backed scorer serves with
+    (README "Scoring kernel"): ``exact`` (materialize [B,N] f32 +
+    top_k, the baseline), ``fused`` (tiled streaming top-k, f32 — the
+    [B,N] score matrix never exists), ``fused_bf16`` / ``fused_int8``
+    (same kernel over bf16 / per-row-scaled int8 resident factors, f32
+    accumulation — device factor bytes halved / quartered), and
+    ``twostage`` (rotated truncated int8 scan to a ``shortlist``-sized
+    candidate set, exact f32 rescore of the shortlist — for catalogs
+    where even fused-exact is too slow). ``tile_items`` is the item-tile
+    width of the streaming scan (rounded up to a power of two — it is
+    part of the compile key); ``shortlist`` the two-stage candidate
+    count per query. Every non-exact scorer is parity-gated at build
+    (deploy warm-up) against the exact path and falls back to exact
+    below ``min_recall`` recall@10.
+    """
+
+    mode: str = "exact"
+    tile_items: int = 16384
+    shortlist: int = 512
+    min_recall: float = 0.99
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None,
+                 variant: Optional[dict] = None) -> "ScorerConfig":
+        """Per-knob precedence, weakest first: server.json ``scorer``
+        section (``data``) < engine.json ``scorer`` section
+        (``variant``) < ``PIO_SCORER_*`` env. Malformed knobs are
+        logged and fall back, same contract as ServingConfig."""
+        data = data or {}
+        variant = variant or {}
+        cfg = cls()
+
+        def as_mode(v):
+            s = str(v).strip().lower()
+            if s not in ("exact", "fused", "fused_bf16", "fused_int8",
+                         "twostage"):
+                raise ValueError(s)
+            return s
+
+        file_keys = (
+            ("mode", "mode", as_mode),
+            ("tileItems", "tile_items", int),
+            ("shortlist", "shortlist", int),
+            ("minRecall", "min_recall", float),
+        )
+        env_keys = (
+            ("PIO_SCORER_MODE", "mode", as_mode),
+            ("PIO_SCORER_TILE_ITEMS", "tile_items", int),
+            ("PIO_SCORER_SHORTLIST", "shortlist", int),
+        )
+        sources = (
+            [(k, data.get(k), attr, conv) for k, attr, conv in file_keys]
+            + [(f"engine.json {k}", variant.get(k), attr, conv)
+               for k, attr, conv in file_keys]
+            + [(k, os.environ.get(k), attr, conv)
+               for k, attr, conv in env_keys]
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed scorer knob %s=%r",
+                               name, raw)
+        cfg.tile_items = max(128, cfg.tile_items)
+        cfg.shortlist = max(16, cfg.shortlist)
+        cfg.min_recall = min(1.0, max(0.0, cfg.min_recall))
+        return cfg
+
+    def cache_key(self) -> tuple:
+        """What invalidates a built scorer when the config changes."""
+        return (self.mode, self.tile_items, self.shortlist,
+                self.min_recall)
+
+
+def scorer_config(variant_section: Optional[dict] = None) -> ScorerConfig:
+    """Resolve the scoring-kernel knobs a serving/scoring process should
+    run with: ``variant_section`` is the engine.json top-level
+    ``scorer`` section, which overrides the host-level server.json
+    section; the ``PIO_SCORER_*`` env vars override both."""
+    data = read_server_json().get("scorer") or {}
+    return ScorerConfig.from_env(data, variant_section)
 
 
 def foldin_config(variant_section: Optional[dict] = None) -> FoldinConfig:
@@ -840,6 +934,7 @@ class ServerConfig:
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     foldin: FoldinConfig = dataclasses.field(default_factory=FoldinConfig)
+    scorer: ScorerConfig = dataclasses.field(default_factory=ScorerConfig)
     batchpredict: BatchPredictConfig = dataclasses.field(
         default_factory=BatchPredictConfig)
     orchestrator: OrchestratorConfig = dataclasses.field(
@@ -862,6 +957,7 @@ class ServerConfig:
             ingest=IngestConfig.from_env(data.get("ingest") or {}),
             train=TrainConfig.from_env(data.get("train") or {}),
             foldin=FoldinConfig.from_env(data.get("foldin") or {}),
+            scorer=ScorerConfig.from_env(data.get("scorer") or {}),
             batchpredict=BatchPredictConfig.from_env(
                 data.get("batchpredict") or {}),
             orchestrator=OrchestratorConfig.from_env(

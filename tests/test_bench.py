@@ -378,6 +378,46 @@ def test_bench_batch_predict_smoke(tmp_path):
         <= detail["compile_shape_bound"]
 
 
+def test_bench_topk_scoring_smoke(tmp_path):
+    """Smoke the topk_scoring config at a shrunken catalog: the config
+    itself asserts recall parity, the quantized factor-byte halving,
+    and the scoring compile ledger; the speedup floor is relaxed — at
+    16k items the exact matmul is nowhere near the bandwidth wall the
+    judged 262k-item run measures against."""
+    p = _run("topk_scoring", "300", timeout=280, tmp_path=tmp_path,
+             extra_env={"BENCH_TOPK_ITEMS": "16384",
+                        "BENCH_TOPK_RANK": "16",
+                        "BENCH_TOPK_BATCH": "4",
+                        "BENCH_TOPK_BATCHES": "2",
+                        "BENCH_TOPK_TILE": "4096",
+                        "BENCH_TOPK_SHORTLIST": "96",
+                        "BENCH_TOPK_MIN_SPEEDUP": "0.05"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert "topk_scoring" in out["unit"]
+    detail = next(d for d in
+                  json.load(open(tmp_path / "details.json"))["details"]
+                  if d["name"] == "topk_scoring")
+    for key in ("qps_exact", "qps_fused", "qps_fused_bf16",
+                "qps_fused_int8", "qps_twostage", "speedup_twostage",
+                "recall_fused", "recall_fused_int8", "recall_twostage",
+                "factor_bytes_fused_int8", "compile_ledger_delta",
+                "compile_ledger_bound"):
+        assert key in detail, (key, detail)
+    # the parity + memory + ledger contracts hold even at smoke scale
+    assert detail["recall_twostage"] >= 0.99
+    assert detail["factor_bytes_fused_int8"] * 2 <= 16384 * 16 * 4
+    assert 0 < detail["compile_ledger_delta"] \
+        <= detail["compile_ledger_bound"]
+    # the run landed in the per-config perf-trajectory history
+    history = json.load(open(tmp_path / "BENCH_topk_scoring.json"))
+    assert len(history) == 1
+    assert history[0]["detail"]["speedup_twostage"] == \
+        detail["speedup_twostage"]
+
+
 def test_every_bench_config_has_smoke():
     """Static gate: every bench.py config must either have a `_run(...)`
     smoke in this file or a justified HEAVY_EXEMPT entry — future

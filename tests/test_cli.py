@@ -257,6 +257,9 @@ def test_cli_deploy_serves_and_stops(clienv, tmp_path, monkeypatch):
         port = s.getsockname()[1]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # serve through the quantized kernel: the deploy must echo the
+    # resolved scorer mode and /deploy/status.json must mirror it
+    env["PIO_SCORER_MODE"] = "fused_int8"
     proc = subprocess.Popen(
         [_sys.executable, "-m", "predictionio_tpu.cli.main", "deploy",
          "--port", str(port), "--accesskey", "DK"],
@@ -280,11 +283,17 @@ def test_cli_deploy_serves_and_stops(clienv, tmp_path, monkeypatch):
             except OSError:
                 continue
         assert body and len(body["itemScores"]) == 3, body
+        status = json.loads(urllib.request.urlopen(
+            f"http://localhost:{port}/deploy/status.json",
+            timeout=5).read())
+        assert status["scorer"]["mode"] == "fused_int8", status
         # undeploy via /stop with the access key (CreateServer.scala:635)
         req = urllib.request.Request(
             f"http://localhost:{port}/stop?accessKey=DK", data=b"")
         urllib.request.urlopen(req, timeout=5)
         proc.wait(timeout=30)
+        out = proc.stdout.read()
+        assert "Scoring kernel fused_int8" in out, out[-2000:]
     finally:
         if proc.poll() is None:
             proc.kill()

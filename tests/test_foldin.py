@@ -892,6 +892,134 @@ def test_foldin_apply_preserves_resident_device_copy():
     assert grown.V_device is not dev               # identity check fired
 
 
+def test_foldin_apply_requantizes_scorer_on_item_fold():
+    """Quantized-resident units (ops/scoring): a user-only drift keeps
+    the quantized scorer copy (V unchanged, identity cache hits); an
+    item fold swaps V, so the carried cache misses and the next scored
+    batch REQUANTIZES the updated rows — and serves them."""
+    from predictionio_tpu.ops import scoring
+    from predictionio_tpu.utils.server_config import ScorerConfig
+
+    scoring.set_process_scorer_config(ScorerConfig(mode="fused_int8",
+                                                   tile_items=128))
+    try:
+        model = make_model(n_users=30, n_items=40, rank=8)
+        algo = ALSAlgorithm(AlgorithmParams(rank=8))
+        model.recommend_batch([("u1", 5, (), None)])
+        scorer = model._scorer_cache[2]
+        assert scorer.active_mode == "fused_int8"
+
+        user_only = algo.foldin_apply(
+            model, None, {"u1": np.ones(8, np.float32)}, {}, None)
+        user_only.recommend_batch([("u1", 5, (), None)])
+        assert user_only._scorer_cache[2] is scorer    # carried, no rebuild
+
+        grown = algo.foldin_apply(
+            model, None, {}, {"zz9": np.full(8, 2.0, np.float32)}, None)
+        out = grown.recommend_batch([("u1", 5, (), None)])
+        assert grown._scorer_cache[2] is not scorer    # requantized
+        assert grown._scorer_cache[2].n_items == 41
+        assert out[0], "quantized unit stopped serving after the fold"
+        # the folded item's row actually serves from the new quantized
+        # copy: a user aligned with it must rank it first
+        aligned = ALSModel(
+            user_vocab=np.asarray(["q"], dtype=object),
+            item_vocab=grown.item_vocab,
+            U=np.full((1, 8), 0.5, np.float32), V=grown.V)
+        top = aligned.recommend_batch([("q", 1, (), None)])[0]
+        assert top[0][0] == "zz9"
+    finally:
+        scoring.set_process_scorer_config(None)
+
+
+async def test_freshness_e2e_on_quantized_unit(foldin_store):
+    """The fold-in loop against a QUANTIZED-resident serving unit
+    (scorer mode fused_int8): fresh events must reflect through the
+    quantized kernel after apply, and /rollback.json must restore the
+    pre-fold-in answers exactly — the drift-swap discipline is
+    scorer-mode independent."""
+    from predictionio_tpu.ops import scoring
+    from predictionio_tpu.server.event_server import EventServer
+    from predictionio_tpu.utils.server_config import (
+        IngestConfig, ScorerConfig,
+    )
+
+    instance = EngineInstance(
+        id="e2e-quant", status="COMPLETED", engine_id=ENGINE_ID,
+        engine_version="1", engine_variant=VARIANT,
+        data_source_params=json.dumps({"appName": APP}))
+    Storage.get_meta_data_engine_instances().insert(instance)
+    base_model = make_model(n_users=30, n_items=40, rank=4)
+    blob = serialize_models([base_model])
+    Storage.get_model_data_models().insert(
+        Model(id=instance.id, models=blob))
+    base_release = record_release(instance, train_seconds=1.0, blob=blob)
+
+    es = EventServer(ingest=IngestConfig(buffer=True, linger_s=0.0))
+    result = TrainResult(
+        models=[base_model],
+        algorithms=[ALSAlgorithm(AlgorithmParams(rank=4))],
+        serving=RecommendationServing(),
+        engine_params=EngineParams(
+            data_source_params=DataSourceParams(app_name=APP)))
+    qs = QueryServer(
+        make_engine(), result, instance, ctx=None,
+        serving_config=ServingConfig(batch_max=8, batch_linger_s=0.0),
+        deploy_config=DeployConfig(warmup=False, drain_timeout_s=5.0),
+        release=base_release,
+        scorer_config=ScorerConfig(mode="fused_int8", tile_items=128),
+        foldin_config=FoldinConfig(enabled=True, apply_interval_s=0.2,
+                                   max_pending=64))
+    ec = TestClient(TestServer(es.app))
+    qc = TestClient(TestServer(qs.app))
+    await ec.start_server()
+    await qc.start_server()
+    try:
+        assert qs._foldin is not None
+
+        async def reflected(user):
+            r = await qc.post("/queries.json",
+                              json={"user": user, "num": 3})
+            assert r.status == 200
+            return (await r.json())["itemScores"]
+
+        # pre-fold-in baseline for an EXISTING user through the
+        # quantized kernel (also builds the scorer)
+        before_u1 = await reflected("u1")
+        assert qs._unit.result.models[0]._scorer_cache[2].active_mode \
+            == "fused_int8"
+        assert await reflected("freshq") == []
+        for j in range(4):
+            r = await ec.post(
+                "/events.json?accessKey=foldin-key",
+                json={"event": "rate", "entityType": "user",
+                      "entityId": "freshq", "targetEntityType": "item",
+                      "targetEntityId": f"i{j}",
+                      "properties": {"rating": 5.0}})
+            assert r.status == 201, await r.text()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if await reflected("freshq"):
+                break
+            await asyncio.sleep(0.05)
+        assert await reflected("freshq"), \
+            "event never reflected through the quantized unit"
+        # the drift still serves quantized
+        st = await (await qc.get("/deploy/status.json")).json()
+        assert st["scorer"]["mode"] == "fused_int8"
+
+        # rollback restores pre-fold-in answers EXACTLY
+        r = await qc.post("/rollback.json")
+        assert r.status == 200, await r.text()
+        assert await reflected("freshq") == []
+        assert await reflected("u1") == before_u1
+        assert qs._unit.result.models[0] is base_model
+    finally:
+        await qc.close()
+        await ec.close()
+        scoring.set_process_scorer_config(None)
+
+
 async def test_item_fold_warms_grown_catalog(foldin_store, monkeypatch):
     """An item-adding apply re-keys the scorers' catalog shape, so the
     controller drives the warmup ladder on the deploy executor BEFORE

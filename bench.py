@@ -1194,6 +1194,48 @@ def cfg_serving_batching(jax, mesh, platform):
             f"observability overhead breached: p99 {obs_on_ms}ms with "
             f"tracing+SLO vs {obs_off_ms}ms obs-off "
             f"(+{overhead_pct:.1f}% > {max_pct}% + {abs_slack_ms}ms)")
+
+        # anatomy overhead: the critical-path stage plane (per-member
+        # stage histograms + exemplar stamping) on vs its kill switch,
+        # with tracing ON both sides — so the comparison isolates the
+        # anatomy cost itself, not the trace plane it rides. Same
+        # alternating best-of-N p99 protocol at the same stable level.
+        hb("serving_batching anatomy-overhead")
+        # pio: ignore[PIO006]: save/restore around the anatomy A/B toggle
+        old_anatomy = os.environ.get("PIO_ANATOMY")
+        # pio: ignore[PIO006]: save/restore around the anatomy A/B toggle
+        old_tracing = os.environ.get("PIO_TRACING")
+        an_on_p99, an_off_p99 = [], []
+        try:
+            os.environ["PIO_TRACING"] = "1"
+            for _ in range(repeats):
+                os.environ["PIO_ANATOMY"] = "0"
+                an_off_p99.append(
+                    sweep(obs_cfg(), obs_level, "anatomy-off")
+                    [obs_level[0]]["p99_ms"])
+                os.environ["PIO_ANATOMY"] = "1"
+                an_on_p99.append(
+                    sweep(obs_cfg(), obs_level, "anatomy-on")
+                    [obs_level[0]]["p99_ms"])
+        finally:
+            for name, old in (("PIO_ANATOMY", old_anatomy),
+                              ("PIO_TRACING", old_tracing)):
+                if old is None:
+                    os.environ.pop(name, None)
+                else:
+                    os.environ[name] = old
+        an_on_ms, an_off_ms = min(an_on_p99), min(an_off_p99)
+        anatomy_pct = (100.0 * (an_on_ms - an_off_ms) / an_off_ms
+                       if an_off_ms > 0 else 0.0)
+        an_max_pct = float(os.environ.get("BENCH_ANATOMY_OVERHEAD_PCT",
+                                          5.0))
+        an_abs_ms = float(os.environ.get(
+            "BENCH_ANATOMY_OVERHEAD_ABS_MS", 0.3))
+        assert an_on_ms <= an_off_ms * (1 + an_max_pct / 100.0) \
+            + an_abs_ms, (
+            f"anatomy overhead breached: p99 {an_on_ms}ms with the "
+            f"stage plane on vs {an_off_ms}ms off "
+            f"(+{anatomy_pct:.1f}% > {an_max_pct}% + {an_abs_ms}ms)")
     finally:
         als_mod._DEVICE_ROUNDTRIP_S = old_rt
 
@@ -1230,9 +1272,14 @@ def cfg_serving_batching(jax, mesh, platform):
     detail[f"p99_ms_{obs_c}c_obs_on"] = obs_on_ms
     detail[f"p99_ms_{obs_c}c_obs_off"] = obs_off_ms
     detail["obs_overhead_pct"] = round(overhead_pct, 2)
+    detail[f"p99_ms_{obs_c}c_anatomy_on"] = an_on_ms
+    detail[f"p99_ms_{obs_c}c_anatomy_off"] = an_off_ms
+    detail["anatomy_overhead_pct"] = round(anatomy_pct, 2)
     detail["note"] += (f"; obs overhead {overhead_pct:+.1f}% at {obs_c}c "
                        f"(tracing+SLO p99 {obs_on_ms}ms vs obs-off "
-                       f"{obs_off_ms}ms)")
+                       f"{obs_off_ms}ms); anatomy overhead "
+                       f"{anatomy_pct:+.1f}% ({an_on_ms}ms vs "
+                       f"{an_off_ms}ms)")
     return detail
 
 

@@ -38,9 +38,12 @@ KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
     # observability kill switches — read on import/request paths that
     # must work even when config loading is what broke
     "PIO_TRACING": ("predictionio_tpu/obs/tracing.py",),
+    "PIO_ANATOMY": ("predictionio_tpu/obs/anatomy.py",),
     "PIO_SLO": ("predictionio_tpu/obs/slo.py",),
     "PIO_DISPATCH_ATTRIBUTION": ("predictionio_tpu/obs/profiler.py",),
     "PIO_SLOW_REQUEST_SECONDS": ("predictionio_tpu/obs/middleware.py",),
+    "PIO_TRACE_CAPACITY": ("predictionio_tpu/obs/trace_context.py",),
+    "PIO_TRACE_EVENT_CAPACITY": ("predictionio_tpu/obs/trace_context.py",),
     # chaos injection — deliberately env-only so a chaos run can never
     # be committed into a config file
     "PIO_FAULT_KILL": ("predictionio_tpu/storage/faults.py",),

@@ -777,6 +777,54 @@ def slo(ip, port):
                    f"[{windows}]")
 
 
+@cli.command()
+@click.option("--ip", default="localhost")
+@click.option("--port", default=8000, type=int)
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw /capacity.json body.")
+def capacity(ip, port, as_json):
+    """Read a live server's device-memory ledger (GET /capacity.json):
+    process-level device bytes / watermark / host RSS, plus per serving
+    unit the resident factor, quantized-scorer and shortlist bytes.
+    Works against any server in the fleet."""
+    import urllib.request
+
+    url = f"http://{ip}:{port}/capacity.json"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.loads(r.read().decode())
+    except Exception as e:
+        click.echo(f"[ERROR] Unable to read {url}: {e}")
+        sys.exit(1)
+    if as_json:
+        click.echo(json.dumps(doc, indent=1, sort_keys=True))
+        return
+
+    def _mb(n):
+        return f"{float(n or 0) / (1 << 20):.1f}MiB"
+
+    proc = doc.get("process") or {}
+    click.echo(f"[INFO] process: device {_mb(proc.get('deviceBytes'))} "
+               f"across {int(proc.get('deviceArrays') or 0)} array(s), "
+               f"watermark {_mb(proc.get('deviceWatermarkBytes'))}, "
+               f"host RSS {_mb(proc.get('hostRssBytes'))}")
+    units = doc.get("units") or []
+    for u in units:
+        click.echo(f"[INFO] unit {u.get('role')}: resident "
+                   f"{_mb(u.get('residentBytes'))} (scorer "
+                   f"{_mb(u.get('scorerBytes'))}) release "
+                   f"v{u.get('release')} instance "
+                   f"{u.get('engineInstanceId')}")
+        for m in u.get("models") or []:
+            click.echo(f"[INFO]   {m.get('model')}: factors "
+                       f"{_mb(m.get('modelFactorBytes'))} + scorer "
+                       f"{_mb(m.get('scorerFactorBytes'))} + shortlist "
+                       f"{_mb(m.get('shortlistBytes'))}")
+    if not units:
+        click.echo("[INFO] no serving units reported (event server, "
+                   "admin and dashboard answer process-level only).")
+
+
 # ---------------------------------------------------------------------------
 # durable telemetry (obs/tsdb.py + obs/telemetry.py)
 # ---------------------------------------------------------------------------
@@ -900,6 +948,84 @@ def metrics_query(name, since, as_rate, quantile, label_filters, dirpath,
     if not shown:
         click.echo(f"[INFO] no data for {name} in the window "
                    f"(root {root}).")
+
+
+@cli.command()
+@click.option("--path", "anatomy_path", default="serving",
+              type=click.Choice(["serving", "ingest"]),
+              help="Which critical path to analyze (default serving).")
+@click.option("--since", default="1h", metavar="30m",
+              help="Trailing window (e.g. 45s, 30m, 2h; default 1h).")
+@click.option("--diff", "do_diff", is_flag=True,
+              help="Two-window regression diff: the trailing window vs "
+                   "the equal-length window before it; names the stage "
+                   "the regression came from.")
+@click.option("--dir", "dirpath", default=None,
+              help="Telemetry root (default $PIO_HOME/telemetry or "
+                   "PIO_TELEMETRY_DIR).")
+@click.option("--json", "as_json", is_flag=True)
+def analyze(anatomy_path, since, do_diff, dirpath, as_json):
+    """Tail anatomy off the durable telemetry store: where p50 and p99
+    requests spend their wall, per critical-path stage
+    (pio_anatomy_stage_seconds), with an optional two-window diff that
+    names the stage a latency regression came from."""
+    import time as _time
+
+    from predictionio_tpu.obs.anatomy import (
+        composition, regression_diff, stage_stats,
+    )
+
+    root, reader = _history_reader(dirpath)
+    window_ms = int(_parse_duration_s(since) * 1000)
+    now_ms = int(_time.time() * 1000)
+    since_ms = now_ms - window_ms
+    stats = stage_stats(reader, anatomy_path, since_ms=since_ms)
+    diff = None
+    if do_diff:
+        before = stage_stats(reader, anatomy_path,
+                             since_ms=since_ms - window_ms,
+                             until_ms=since_ms)
+        if before and stats:
+            diff = regression_diff(before, stats)
+    if as_json:
+        click.echo(json.dumps({
+            "path": anatomy_path, "sinceMs": since_ms,
+            "stages": stats,
+            "p50Composition": composition(stats, anatomy_path, "p50"),
+            "p99Composition": composition(stats, anatomy_path, "p99"),
+            "diff": diff}, sort_keys=True))
+        return
+    if not stats:
+        click.echo(f"[INFO] no anatomy history for path={anatomy_path} "
+                   f"in the window (root {root}; is PIO_ANATOMY on and "
+                   "telemetry persisting?).")
+        return
+    p50_comp = composition(stats, anatomy_path, "p50")
+    p99_comp = composition(stats, anatomy_path, "p99")
+    requests = max(s["count"] for s in stats.values())
+    click.echo(f"[INFO] {anatomy_path} anatomy over {since} "
+               f"({requests:g} request(s)):")
+    click.echo(f"[INFO]   {'stage':<16} {'mean':>9} {'p50':>9} "
+               f"{'p99':>9} {'p50 share':>10} {'p99 share':>10}")
+    for stage, s in sorted(stats.items(), key=lambda kv: -kv[1]["p99"]):
+        def _share(comp):
+            return (f"{100.0 * comp[stage]:.0f}%"
+                    if stage in comp else "-")
+        click.echo(
+            f"[INFO]   {stage:<16} {1e3 * s['mean']:>7.2f}ms "
+            f"{1e3 * s['p50']:>7.2f}ms {1e3 * s['p99']:>7.2f}ms "
+            f"{_share(p50_comp):>10} {_share(p99_comp):>10}")
+    if do_diff:
+        if diff is None:
+            click.echo("[INFO] diff: not enough history in the "
+                       "baseline window.")
+        else:
+            click.echo(
+                f"[INFO] regression diff vs previous {since}: stage "
+                f"'{diff['stage']}' moved most "
+                f"({1e3 * diff['beforeMeanS']:.2f}ms -> "
+                f"{1e3 * diff['afterMeanS']:.2f}ms mean, "
+                f"{1e3 * diff['deltaMeanS']:+.2f}ms)")
 
 
 @cli.command()

@@ -45,7 +45,12 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 from predictionio_tpu.data.event import Event
-from predictionio_tpu.obs.tracing import capture_context, carried, span
+from predictionio_tpu.obs.anatomy import (
+    anatomy_enabled, anatomy_metrics, observe_ingest_batch,
+)
+from predictionio_tpu.obs.tracing import (
+    capture_context, carried, current_trace, span,
+)
 from predictionio_tpu.storage.base import StorageError, generate_id
 from predictionio_tpu.utils.retry import RetryPolicy, start_attempt_thread
 
@@ -127,16 +132,24 @@ class _Pending:
     ``trace`` is the submitting request's captured trace context — the
     writer thread re-enters it around the flush so the group-commit span
     is linked to the request that triggered it instead of starting a
-    fresh, unattributable trace (the thread boundary used to drop it)."""
+    fresh, unattributable trace (the thread boundary used to drop it).
+    ``t_submit``/``req_trace`` feed the ingest anatomy: when the flush
+    lands, each submitter's flush-wait and shared commit wall are
+    observed into ``pio_anatomy_stage_seconds{path="ingest"}`` and onto
+    the submitter's own trace as ``anatomy_*`` pseudo-spans."""
 
-    __slots__ = ("events", "app_id", "channel_id", "future", "trace")
+    __slots__ = ("events", "app_id", "channel_id", "future", "trace",
+                 "t_submit", "req_trace")
 
-    def __init__(self, events, app_id, channel_id, future, trace=None):
+    def __init__(self, events, app_id, channel_id, future, trace=None,
+                 t_submit=0.0, req_trace=None):
         self.events = events
         self.app_id = app_id
         self.channel_id = channel_id
         self.future = future
         self.trace = trace
+        self.t_submit = t_submit
+        self.req_trace = req_trace
 
 
 class WriteBuffer:
@@ -170,8 +183,10 @@ class WriteBuffer:
 
         self._shed_total = self._retry_total = None
         self._flush_size = self._flush_duration = None
+        self._anatomy = None
         self._registry = registry
         if registry is not None:
+            self._anatomy = anatomy_metrics(registry)
             registry.gauge_callback(
                 "pio_ingest_queue_depth",
                 "Events buffered for group commit (queued + in flush)",
@@ -217,7 +232,9 @@ class WriteBuffer:
                     self._shed_total.inc(len(events))
                 raise BufferFull(self._depth, self._retry_after(self._depth))
             self._queue.append(_Pending(events, app_id, channel_id, future,
-                                        trace=capture_context()))
+                                        trace=capture_context(),
+                                        t_submit=time.perf_counter(),
+                                        req_trace=current_trace()))
             self._depth += len(events)
             if self._thread is None:
                 self._thread = threading.Thread(
@@ -268,6 +285,7 @@ class WriteBuffer:
             groups.setdefault((p.app_id, p.channel_id), []).append(p)
         for (app_id, channel_id), pendings in groups.items():
             events = [e for p in pendings for e in p.events]
+            t_flush_start = time.perf_counter()
             try:
                 ids = self._flush_traced(events, app_id, channel_id,
                                          pendings)
@@ -279,6 +297,15 @@ class WriteBuffer:
                         e if isinstance(e, StorageError)
                         else StorageError(str(e)))
                 continue
+            if self._anatomy is not None and anatomy_enabled():
+                try:
+                    observe_ingest_batch(
+                        self._anatomy,
+                        [(p.t_submit, p.req_trace) for p in pendings],
+                        t_flush_start,
+                        time.perf_counter() - t_flush_start)
+                except Exception:
+                    logger.exception("ingest anatomy observation failed")
             pos = 0
             for p in pendings:
                 n = len(p.events)

@@ -1,0 +1,198 @@
+"""Device-memory capacity ledger: where device (and host) bytes live.
+
+ROADMAP item 1's multi-tenant memory budgeter needs an answer to "what
+does THIS serving unit cost to keep resident?" — until now that number
+existed only as ``factor_bytes`` inside ``ops/scoring.py``. This module
+rolls it up:
+
+* **per-unit residency** — for every :class:`~predictionio_tpu.deploy.
+  warm.ServingUnit`: the model's device-resident factor matrices
+  (``ALSModel._resident``), the quantized scorer residency (tiles +
+  scales, the scorer's own ``factorBytes``), and the two-stage
+  shortlist machinery's rotation matrix;
+* **process level** — live device-array bytes and high-water mark (one
+  TTL-memoized ``jax.live_arrays()`` walk shared with the
+  ``pio_jax_*`` gauges), plus a sampled host VmRSS;
+* surfaced as gauges (``pio_capacity_*``), at ``GET /capacity.json`` on
+  all four servers, in the dashboard capacity panel, and via
+  ``pio capacity``.
+
+Import-light by design: aiohttp only inside the route helper, jax only
+via obs/jax_stats' already-imported gate — the CLI can format a
+capacity document without server deps.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+from predictionio_tpu.obs import jax_stats
+from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
+
+CAPACITY_PATH = "/capacity.json"
+
+DEVICE_BYTES_GAUGE = "pio_capacity_device_bytes"
+DEVICE_WATERMARK_GAUGE = "pio_capacity_device_watermark_bytes"
+HOST_RSS_GAUGE = "pio_capacity_host_rss_bytes"
+UNIT_RESIDENT_GAUGE = "pio_capacity_unit_resident_bytes"
+
+#: host-RSS sampling window — /proc reads are cheap but not free, and
+#: the telemetry loop can scrape sub-second
+RSS_TTL_S = 1.0
+_rss_cache = (float("-inf"), 0.0)   # (monotonic ts, bytes)
+
+
+def _read_rss_bytes() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except (OSError, ValueError, IndexError):
+        pass
+    try:    # non-procfs fallback: peak RSS is the best signal available
+        import resource
+
+        return float(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024.0
+    except Exception:
+        return 0.0
+
+
+def host_rss_bytes(ttl_s: float = RSS_TTL_S) -> float:
+    """Sampled resident-set size of this process (bytes), memoized for
+    `ttl_s` (benign races: worst case two samples in a window)."""
+    global _rss_cache
+    now = time.monotonic()
+    ts, value = _rss_cache
+    if now - ts < ttl_s:
+        return value
+    value = _read_rss_bytes()
+    _rss_cache = (now, value)
+    return value
+
+
+# ---------------------------------------------------------------------------
+# per-unit residency
+# ---------------------------------------------------------------------------
+
+def model_capacity(model) -> Dict:
+    """One model's residency breakdown. Every field is best-effort reads
+    of caches that may not exist yet (scorer residency is lazy — a unit
+    that never scored on device holds none)."""
+    entry = {"model": type(model).__name__,
+             "modelFactorBytes": 0, "scorerFactorBytes": 0,
+             "shortlistBytes": 0, "exactBytes": 0, "residentBytes": 0}
+    resident = getattr(model, "_resident", None)
+    if resident is not None:
+        try:
+            entry["modelFactorBytes"] = int(resident[1].nbytes)
+        except Exception:
+            pass
+    cached = getattr(model, "_scorer_cache", None)
+    if cached is not None:
+        scorer = cached[2]
+        try:
+            status = scorer.status()
+            entry["scorer"] = status
+            entry["scorerFactorBytes"] = int(status.get("factorBytes", 0))
+            entry["exactBytes"] = int(status.get("exactBytes", 0))
+        except Exception:
+            pass
+        rotation = getattr(scorer, "_rotation", None)
+        if rotation is not None:
+            try:
+                entry["shortlistBytes"] = int(rotation.nbytes)
+            except Exception:
+                pass
+    entry["residentBytes"] = (entry["modelFactorBytes"]
+                              + entry["scorerFactorBytes"]
+                              + entry["shortlistBytes"])
+    return entry
+
+
+def unit_capacity(unit, role: str) -> Dict:
+    """Residency roll-up for one serving unit (active/standby/canary).
+    ``scorerBytes`` is exactly the sum of the scorers' ``factorBytes``
+    (quantized modes included) — the number /deploy/status.json echoes,
+    so the two endpoints can be cross-checked."""
+    result = getattr(unit, "result", None)
+    models = [model_capacity(m)
+              for m in (getattr(result, "models", ()) or ())]
+    instance = getattr(unit, "instance", None)
+    return {
+        "role": role,
+        "engineInstanceId": getattr(instance, "id", None),
+        "release": getattr(unit, "release_version", None),
+        "scorerBytes": sum(m["scorerFactorBytes"] for m in models),
+        "residentBytes": sum(m["residentBytes"] for m in models),
+        "models": models,
+    }
+
+
+def capacity_document(units_fn: Optional[Callable[[], Iterable[Dict]]]
+                      = None) -> Dict:
+    """The /capacity.json body: process-level device/host footprint plus
+    per-unit residency when the server has units to report."""
+    device_bytes, device_arrays = jax_stats.live_buffer_stats()
+    doc = {
+        "ts": time.time(),
+        "process": {
+            "deviceBytes": device_bytes,
+            "deviceArrays": device_arrays,
+            "deviceWatermarkBytes": jax_stats.device_watermark_bytes(),
+            "hostRssBytes": host_rss_bytes(),
+        },
+        "units": [],
+    }
+    if units_fn is not None:
+        try:
+            doc["units"] = list(units_fn())
+        except Exception:
+            doc["units"] = []
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# gauges + route
+# ---------------------------------------------------------------------------
+
+def register_capacity_metrics(registry: MetricsRegistry = None,
+                              units_fn: Optional[Callable] = None
+                              ) -> MetricsRegistry:
+    """Idempotently register the capacity gauges; with a `units_fn`
+    (query server) the per-unit resident gauge reports one sample per
+    unit role — role, not instance id, keeps the cardinality fixed."""
+    reg = registry or default_registry()
+    reg.gauge_callback(
+        DEVICE_BYTES_GAUGE,
+        "Bytes held by live device arrays (shared TTL-memoized walk)",
+        lambda: jax_stats.live_buffer_stats()[0])
+    reg.gauge_callback(
+        DEVICE_WATERMARK_GAUGE,
+        "High-water mark of live device-array bytes since process start",
+        jax_stats.device_watermark_bytes)
+    reg.gauge_callback(
+        HOST_RSS_GAUGE, "Sampled host resident-set size", host_rss_bytes)
+    if units_fn is not None:
+        def _unit_samples():
+            return [({"role": str(u.get("role", "?"))},
+                     float(u.get("residentBytes", 0)))
+                    for u in units_fn()]
+        reg.gauge_callback(
+            UNIT_RESIDENT_GAUGE,
+            "Device-resident bytes per serving unit (factors + quantized "
+            "scorer + shortlist rotation)",
+            _unit_samples, labelnames=("role",))
+    return reg
+
+
+def add_capacity_route(app, units_fn: Optional[Callable] = None) -> None:
+    """Mount GET /capacity.json (all four servers call this)."""
+    from aiohttp import web
+
+    async def handle_capacity(request):
+        return web.json_response(capacity_document(units_fn))
+
+    app.router.add_get(CAPACITY_PATH, handle_capacity)

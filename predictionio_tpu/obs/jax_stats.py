@@ -13,10 +13,24 @@ prevent).
 from __future__ import annotations
 
 import sys
+import threading
+import time
 
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
 
 COMPILE_COUNTER = "pio_jax_compile_total"
+
+#: how long one jax.live_arrays() walk is reused across gauges — the
+#: bytes and count gauges (and the capacity ledger's watermark) share a
+#: single O(live-arrays) sum per window instead of one walk per gauge
+#: per scrape, which matters under sub-second telemetry intervals
+LIVE_BUFFER_TTL_S = 0.5
+
+_live_lock = threading.Lock()
+_live_cache = (0.0, 0.0)   # (bytes, count)
+_live_cache_ts = float("-inf")
+_live_walks = 0            # walks actually performed (tests assert this)
+_live_watermark = 0.0      # max bytes ever seen by a walk (capacity ledger)
 
 
 def compile_counter(registry: MetricsRegistry = None):
@@ -42,24 +56,51 @@ def _device_count() -> float:
         return 0.0
 
 
-def _live_buffer_bytes() -> float:
+def live_buffer_stats(ttl_s: float = LIVE_BUFFER_TTL_S
+                      ) -> "tuple[float, float]":
+    """(bytes, count) over live device arrays, memoized for `ttl_s`:
+    one walk serves every gauge that fires inside the window."""
+    global _live_cache, _live_cache_ts, _live_walks, _live_watermark
     jax = _jax()
     if jax is None:
-        return 0.0
-    try:
-        return float(sum(int(a.nbytes) for a in jax.live_arrays()))
-    except Exception:
-        return 0.0
+        return (0.0, 0.0)
+    now = time.monotonic()
+    with _live_lock:
+        if now - _live_cache_ts < ttl_s:
+            return _live_cache
+        try:
+            arrays = jax.live_arrays()
+            stats = (float(sum(int(a.nbytes) for a in arrays)),
+                     float(len(arrays)))
+        except Exception:
+            stats = (0.0, 0.0)
+        _live_walks += 1
+        _live_cache, _live_cache_ts = stats, now
+        if stats[0] > _live_watermark:
+            _live_watermark = stats[0]
+        return stats
+
+
+def live_buffer_walks() -> int:
+    """How many live_arrays() walks have actually run (TTL-memoization
+    observability; tests assert scrapes inside the window share one)."""
+    with _live_lock:
+        return _live_walks
+
+
+def device_watermark_bytes() -> float:
+    """High-water mark of live device-array bytes seen by any walk since
+    process start — the capacity ledger's 'how close did we get' gauge."""
+    with _live_lock:
+        return _live_watermark
+
+
+def _live_buffer_bytes() -> float:
+    return live_buffer_stats()[0]
 
 
 def _live_buffer_count() -> float:
-    jax = _jax()
-    if jax is None:
-        return 0.0
-    try:
-        return float(len(jax.live_arrays()))
-    except Exception:
-        return 0.0
+    return live_buffer_stats()[1]
 
 
 def register_jax_metrics(registry: MetricsRegistry = None) -> MetricsRegistry:

@@ -31,6 +31,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 #: 0.5 ms .. ~16 s, doubling — covers a jitted matvec through a cold
@@ -50,6 +51,22 @@ DEFAULT_MAX_SERIES = 2048
 OVERFLOW_COUNTER = "pio_obs_label_overflow_total"
 #: the label value overflowing combinations collapse into
 OVERFLOW_LABEL_VALUE = "other"
+
+#: exemplar source consulted by Histogram.observe — returns the active
+#: trace id, or None when no request context is live. Installed by
+#: obs/anatomy.py at import (a late hook keeps this module
+#: dependency-free: registry cannot import tracing, which imports it).
+_exemplar_provider: Optional[Callable[[], Optional[str]]] = None
+
+#: one exemplar is (trace_id, observed value, unix ts) — newest wins
+Exemplar = Tuple[str, float, float]
+
+
+def set_exemplar_provider(
+        fn: Optional[Callable[[], Optional[str]]]) -> None:
+    """Install (or clear, with None) the process-wide exemplar source."""
+    global _exemplar_provider
+    _exemplar_provider = fn
 
 
 def exponential_buckets(start: float, factor: float, count: int
@@ -274,10 +291,23 @@ class Histogram(_Metric):
         #: key -> [per-bucket counts..., +Inf count] plus running sum
         self._counts: Dict[Tuple[str, ...], List[float]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
+        #: key -> per-bucket exemplar slots (same layout as counts, one
+        #: slot per bucket plus +Inf); newest observation with a live
+        #: trace id wins its slot. Bounded by construction: at most
+        #: (buckets+1) tuples per live series.
+        self._exemplars: Dict[Tuple[str, ...],
+                              List[Optional[Exemplar]]] = {}
 
     def observe(self, value: float, **labels) -> None:
         key = self._key(labels)
         idx = bisect.bisect_left(self.buckets, value)
+        tid = None
+        provider = _exemplar_provider
+        if provider is not None:
+            try:
+                tid = provider()
+            except Exception:
+                tid = None
         with self._lock:
             key, overflowed = self._guarded_key(key, self._counts)
             counts = self._counts.get(key)
@@ -285,6 +315,12 @@ class Histogram(_Metric):
                 counts = self._counts[key] = [0.0] * (len(self.buckets) + 1)
             counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
+            if tid is not None:
+                slots = self._exemplars.get(key)
+                if slots is None:
+                    slots = self._exemplars[key] = \
+                        [None] * (len(self.buckets) + 1)
+                slots[idx] = (tid, value, time.time())
         if overflowed:
             self._note_overflow()
 
@@ -310,24 +346,39 @@ class Histogram(_Metric):
         with self._lock:
             items = sorted(self._counts.items())
             sums = dict(self._sums)
+            exemplars = {k: list(v) for k, v in self._exemplars.items()}
+        series = []
+        for key, counts in items:
+            s = {"labels": dict(zip(self.labelnames, key)),
+                 "counts": list(counts),
+                 "sum": sums.get(key, 0.0)}
+            slots = exemplars.get(key)
+            if slots and any(e is not None for e in slots):
+                s["exemplars"] = [list(e) if e is not None else None
+                                  for e in slots]
+            series.append(s)
         return {"kind": self.kind, "help": self.help,
                 "labelnames": list(self.labelnames),
                 "buckets": list(self.buckets),
-                "series": [{"labels": dict(zip(self.labelnames, key)),
-                            "counts": list(counts),
-                            "sum": sums.get(key, 0.0)}
-                           for key, counts in items]}
+                "series": series}
 
     def _merge_series(self, labels: Dict[str, str], counts: Sequence[float],
-                      sum_: float) -> None:
+                      sum_: float,
+                      exemplars: Optional[Sequence] = None) -> None:
         """Elementwise-add raw per-bucket counts (fleet merge). The
         caller has verified bucket-bound equality; count vectors are the
-        raw per-bucket layout to_snapshot exports."""
+        raw per-bucket layout to_snapshot exports. Exemplar slots merge
+        newest-per-bucket by timestamp (exemplars are evidence pointers,
+        not additive samples)."""
         key = self._key(labels)
         if len(counts) != len(self.buckets) + 1:
             raise ValueError(
                 f"{self.name}: snapshot has {len(counts)} buckets, "
                 f"this histogram has {len(self.buckets) + 1}")
+        if exemplars is not None and len(exemplars) != len(counts):
+            raise ValueError(
+                f"{self.name}: snapshot has {len(exemplars)} exemplar "
+                f"slots for {len(counts)} buckets")
         with self._lock:
             key, overflowed = self._guarded_key(key, self._counts)
             mine = self._counts.get(key)
@@ -336,8 +387,50 @@ class Histogram(_Metric):
             for i, c in enumerate(counts):
                 mine[i] += c
             self._sums[key] = self._sums.get(key, 0.0) + sum_
+            if exemplars is not None:
+                slots = self._exemplars.get(key)
+                if slots is None:
+                    slots = self._exemplars[key] = \
+                        [None] * (len(self.buckets) + 1)
+                for i, ex in enumerate(exemplars):
+                    if ex is None:
+                        continue
+                    ex = (str(ex[0]), float(ex[1]), float(ex[2]))
+                    if slots[i] is None or ex[2] >= slots[i][2]:
+                        slots[i] = ex
         if overflowed:
             self._note_overflow()
+
+    # -- exemplars (SLO evidence + exposition read these) --------------------
+    def exemplars(self, **labels) -> List[Optional[Exemplar]]:
+        """Per-bucket exemplar slots ([+Inf] last), None where no
+        exemplar has landed. No labels = newest-per-bucket merged across
+        every series."""
+        if labels:
+            key = self._key(labels)
+            with self._lock:
+                slots = self._exemplars.get(key)
+                return (list(slots) if slots
+                        else [None] * (len(self.buckets) + 1))
+        merged: List[Optional[Exemplar]] = \
+            [None] * (len(self.buckets) + 1)
+        with self._lock:
+            for slots in self._exemplars.values():
+                for i, ex in enumerate(slots):
+                    if ex is not None and (merged[i] is None
+                                           or ex[2] >= merged[i][2]):
+                        merged[i] = ex
+        return merged
+
+    def exemplars_above(self, threshold: float) -> List[Exemplar]:
+        """Exemplars from the buckets at/above `threshold`, filtered to
+        observed values strictly above it, newest first — the 'show me a
+        trace that burned the budget' query SLO breach evidence uses."""
+        idx = bisect.bisect_left(self.buckets, threshold)
+        out = [ex for ex in self.exemplars()[idx:]
+               if ex is not None and ex[1] > threshold]
+        out.sort(key=lambda ex: ex[2], reverse=True)
+        return out
 
     # -- accessors (serving-stats endpoints read these) ----------------------
     def count(self, **labels) -> float:
@@ -410,6 +503,7 @@ class Histogram(_Metric):
         with self._lock:
             items = sorted(self._counts.items())
             sums = dict(self._sums)
+            exemplars = {k: list(v) for k, v in self._exemplars.items()}
         for key, counts in items:
             cumulative = 0.0
             for le, c in zip(self.buckets, counts):
@@ -429,6 +523,21 @@ class Histogram(_Metric):
             lines.append(self.name + "_count"
                          + _format_labels(self.labelnames, key)
                          + " " + _format_value(sum(counts)))
+            # exemplars ride as comment lines so 0.0.4 text parsers (and
+            # this repo's own parse_exposition) stay compatible; scrapers
+            # that understand them match on the "# exemplar " prefix
+            slots = exemplars.get(key)
+            if slots:
+                bounds = [_format_value(b) for b in self.buckets] + ["+Inf"]
+                for le, ex in zip(bounds, slots):
+                    if ex is None:
+                        continue
+                    lines.append(
+                        "# exemplar " + self.name + "_bucket"
+                        + _format_labels(self.labelnames, key,
+                                         extra=(("le", le),))
+                        + f' trace_id="{_escape_label_value(ex[0])}" '
+                        + _format_value(ex[1]) + " " + _format_value(ex[2]))
 
 
 class MetricsRegistry:
@@ -522,6 +631,14 @@ class MetricsRegistry:
                 entry["p50"] = metric.quantile(0.50)
                 entry["p95"] = metric.quantile(0.95)
                 entry["p99"] = metric.quantile(0.99)
+                bounds = ([_format_value(b) for b in metric.buckets]
+                          + ["+Inf"])
+                ex = [{"le": le, "traceId": e[0], "value": e[1],
+                       "ts": e[2]}
+                      for le, e in zip(bounds, metric.exemplars())
+                      if e is not None]
+                if ex:
+                    entry["exemplars"] = ex
             else:
                 entry["samples"] = [
                     {"labels": labels, "value": value}
@@ -575,7 +692,8 @@ class MetricsRegistry:
                         f"{buckets} != registered {m.buckets}")
                 for s in entry.get("series", ()):
                     m._merge_series({**s["labels"], **extra},
-                                    s["counts"], s.get("sum", 0.0))
+                                    s["counts"], s.get("sum", 0.0),
+                                    s.get("exemplars"))
 
 
 def render_prometheus(registries: Iterable[MetricsRegistry]) -> str:

@@ -45,7 +45,7 @@ from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from predictionio_tpu.obs.registry import Histogram, MetricsRegistry
-from predictionio_tpu.obs.trace_context import record_event
+from predictionio_tpu.obs.trace_context import record_event, recorder
 
 logger = logging.getLogger("pio.slo")
 
@@ -342,11 +342,16 @@ class SLOEngine:
                                      objective=obj.name)
             if breached and not was:
                 self._breach_total.inc(objective=obj.name)
-                record_event("slo_breach", {
-                    "objective": obj.name, "objectiveKind": obj.kind,
-                    "windows": windows})
-                logger.warning("SLO breach: %s (%s) %s",
-                               obj.name, obj.kind, windows)
+                detail = {"objective": obj.name, "objectiveKind": obj.kind,
+                          "windows": windows}
+                exemplars = self._breach_exemplars(obj)
+                if exemplars:
+                    # evidence, not summary: the actual trace ids that
+                    # burned the budget, pinned so they outlive the ring
+                    detail["exemplars"] = exemplars
+                record_event("slo_breach", detail)
+                logger.warning("SLO breach: %s (%s) %s exemplars=%s",
+                               obj.name, obj.kind, windows, exemplars)
             objectives.append({
                 "name": obj.name, "kind": obj.kind,
                 "thresholdS": obj.threshold_s, "budget": obj.budget,
@@ -367,6 +372,42 @@ class SLOEngine:
         }
         self._last_status = status
         return status
+
+    #: exemplar trace ids one breach event carries (and pins)
+    BREACH_EXEMPLARS = 3
+
+    def _breach_exemplars(self, obj: SLOObjective) -> List[str]:
+        """Culprit trace ids for a latency/freshness breach: the newest
+        histogram exemplars above the objective's threshold, from the
+        same metric the burn rate integrates over. Each id is pinned in
+        the flight recorder so the p99 culprit is still resolvable via
+        ``pio traces --trace-id`` long after the 256-entry ring has
+        rolled past it. Errors objectives carry none — failure traces
+        are already first-class flight-recorder records."""
+        if obj.kind == KIND_ERRORS or not obj.threshold_s:
+            return []
+        metric = LATENCY_METRIC if obj.kind == KIND_LATENCY \
+            else FRESHNESS_METRIC
+        hist = self.registry.get(metric)
+        if not isinstance(hist, Histogram):
+            return []
+        try:
+            above = hist.exemplars_above(obj.threshold_s)
+        except Exception:
+            return []
+        ids: List[str] = []
+        for tid, _value, _ts in above:
+            if tid not in ids:
+                ids.append(tid)
+            if len(ids) >= self.BREACH_EXEMPLARS:
+                break
+        try:
+            rec = recorder()
+            for tid in ids:
+                rec.pin(tid)
+        except Exception:
+            logger.exception("pinning breach exemplar traces failed")
+        return ids
 
     def _window_state(self, ring) -> str:
         """``warm`` once the ring's covered timespan reaches the longest

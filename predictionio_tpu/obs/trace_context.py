@@ -50,6 +50,38 @@ TRACE_HEADER = "X-Pio-Trace"
 DEFAULT_TRACE_CAPACITY = 256
 DEFAULT_EVENT_CAPACITY = 256
 
+#: ring-size knobs, env > server.json "trace" section > default (the
+#: global recorder is built at import, before any config object exists,
+#: so these resolve here rather than through ServerConfig)
+TRACE_CAPACITY_ENV = "PIO_TRACE_CAPACITY"
+TRACE_EVENT_CAPACITY_ENV = "PIO_TRACE_EVENT_CAPACITY"
+
+#: pinned traces (SLO-breach exemplar evidence) kept beyond the ring —
+#: bounded: at most this many trace ids, each capped at _PIN_SPAN_CAP
+DEFAULT_PIN_CAPACITY = 64
+_PIN_SPAN_CAP = 64
+
+
+def _configured_capacity(env_name: str, file_key: str,
+                         default: int) -> int:
+    """Ring capacity from env, else server.json {"trace": {file_key}},
+    else the default; malformed or non-positive values fall back (a bad
+    knob must never keep the recorder from constructing)."""
+    raw = os.environ.get(env_name)
+    if raw is None:
+        try:
+            from predictionio_tpu.utils.server_config import \
+                read_server_json
+
+            raw = (read_server_json().get("trace") or {}).get(file_key)
+        except Exception:
+            raw = None
+    try:
+        value = int(raw) if raw is not None else default
+    except (TypeError, ValueError):
+        return default
+    return value if value > 0 else default
+
 
 def new_trace_id() -> str:
     return uuid.uuid4().hex
@@ -118,8 +150,16 @@ class FlightRecorder:
     each stamped with the trace id active at the time so the two rings
     cross-reference."""
 
-    def __init__(self, capacity: int = DEFAULT_TRACE_CAPACITY,
-                 event_capacity: int = DEFAULT_EVENT_CAPACITY):
+    def __init__(self, capacity: Optional[int] = None,
+                 event_capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = _configured_capacity(
+                TRACE_CAPACITY_ENV, "traceCapacity",
+                DEFAULT_TRACE_CAPACITY)
+        if event_capacity is None:
+            event_capacity = _configured_capacity(
+                TRACE_EVENT_CAPACITY_ENV, "eventCapacity",
+                DEFAULT_EVENT_CAPACITY)
         self._lock = threading.Lock()
         self._traces: "deque[dict]" = deque(maxlen=max(1, capacity))
         self._events: "deque[dict]" = deque(maxlen=max(1, event_capacity))
@@ -127,12 +167,19 @@ class FlightRecorder:
         #: telemetry loop's incremental-persistence cursors ride them
         self._trace_count = 0
         self._event_count = 0
+        #: trace_id -> records kept beyond ring eviction (insertion
+        #: order doubles as FIFO eviction order past DEFAULT_PIN_CAPACITY)
+        self._pinned: Dict[str, List[dict]] = {}
+        self._pin_capacity = DEFAULT_PIN_CAPACITY
 
     # -- traces --------------------------------------------------------------
     def record_trace(self, record: dict) -> None:
         with self._lock:
             self._traces.append(record)
             self._trace_count += 1
+            pinned = self._pinned.get(record.get("traceId"))
+            if pinned is not None and len(pinned) < _PIN_SPAN_CAP:
+                pinned.append(record)
 
     def record_span(self, *, trace_id: str, span_id: str,
                     parent_span_id: Optional[str], name: str,
@@ -176,12 +223,41 @@ class FlightRecorder:
             self._event_count += 1
         return record
 
+    # -- pinning (exemplar evidence outlives the ring) -----------------------
+    def pin(self, trace_id: Optional[str]) -> None:
+        """Keep `trace_id`'s records past ring eviction: existing ring
+        matches are copied aside and future spans of the trace are
+        retained too. Bounded: FIFO-evicts the oldest pinned trace past
+        the pin capacity, each trace capped at a fixed span count. The
+        SLO engine pins its breach exemplars so the p99 culprit is still
+        resolvable by `pio traces --trace-id` long after the burst that
+        buried it."""
+        if not trace_id:
+            return
+        with self._lock:
+            if trace_id not in self._pinned:
+                while len(self._pinned) >= self._pin_capacity:
+                    self._pinned.pop(next(iter(self._pinned)))
+                self._pinned[trace_id] = [
+                    t for t in self._traces
+                    if t.get("traceId") == trace_id][:_PIN_SPAN_CAP]
+
+    def pinned_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._pinned)
+
     # -- readout -------------------------------------------------------------
     def traces(self, trace_id: Optional[str] = None,
                limit: Optional[int] = None,
                since_ts: Optional[float] = None) -> List[dict]:
         with self._lock:
             out = list(self._traces)
+            if trace_id is not None:
+                seen = {id(t) for t in out}
+                for t in self._pinned.get(trace_id, ()):
+                    if id(t) not in seen:
+                        out.append(t)
+                out.sort(key=lambda t: t.get("ts", 0))
         if trace_id is not None:
             out = [t for t in out if t.get("traceId") == trace_id]
         if since_ts is not None:
@@ -240,12 +316,14 @@ class FlightRecorder:
                 limit: Optional[int] = None,
                 since_ts: Optional[float] = None) -> dict:
         return {"traces": self.traces(trace_id, limit, since_ts),
-                "events": self.events(limit, since_ts)}
+                "events": self.events(limit, since_ts),
+                "pinned": self.pinned_ids()}
 
     def clear(self) -> None:
         with self._lock:
             self._traces.clear()
             self._events.clear()
+            self._pinned.clear()
 
 
 def _process_label() -> str:

@@ -157,10 +157,35 @@ class SeriesInfo:
     buckets: Tuple[float, ...] = ()
     #: scalar kinds: [(ts_ms, value)]; histograms: [(ts_ms, counts, sum)]
     points: List[tuple] = dataclasses.field(default_factory=list)
+    #: histogram exemplars, one slot per bucket (+Inf last): None or
+    #: [trace_id, value, unix_ts] — newest-per-bucket across the series'
+    #: whole recorded history (exemplars are evidence pointers, not
+    #: samples, so they merge by recency instead of accumulating)
+    exemplars: List[Optional[list]] = dataclasses.field(
+        default_factory=list)
 
     def key(self) -> tuple:
         return (self.name, tuple(sorted(self.labels.items())),
                 self.kind, self.buckets)
+
+
+def merge_exemplar_slots(dst: List[Optional[list]],
+                         src) -> List[Optional[list]]:
+    """Newest-per-bucket merge of exemplar slot lists (the same algebra
+    the registry's ``merge_snapshot`` uses; slot-count mismatches keep
+    ``dst`` — persisted data is never worth raising over)."""
+    if not src:
+        return dst
+    src = [list(e) if e else None for e in src]
+    if not dst:
+        return src
+    if len(dst) != len(src):
+        return dst
+    for i, e in enumerate(src):
+        if e is not None and (dst[i] is None or
+                              float(e[2]) >= float(dst[i][2])):
+            dst[i] = e
+    return dst
 
 
 class TSDB:
@@ -193,6 +218,9 @@ class TSDB:
         self._defs: Dict[int, dict] = {}   # sid -> series record body
         self._emitted: set = set()         # sids defined in THIS segment
         self._last: Dict[int, object] = {}  # delta-encoding baselines
+        #: last exemplar slots written per sid (unchanged slots are not
+        #: re-appended — exemplars churn far slower than counts)
+        self._last_ex: Dict[int, list] = {}
         self.recover()
 
     # -- the single-writer claim ---------------------------------------------
@@ -352,6 +380,7 @@ class TSDB:
         self._active_started_ms = ts_ms
         self._emitted = set()
         self._last = {}
+        self._last_ex = {}
         self._append_payload({"k": "seg", "v": 1, "t": ts_ms})
 
     def flush(self) -> None:
@@ -395,6 +424,7 @@ class TSDB:
             self._unlink(name)
         self._emitted = set()
         self._last = {}
+        self._last_ex = {}
 
     def maybe_roll(self, now_ms: Optional[int] = None) -> bool:
         now_ms = _now_ms() if now_ms is None else now_ms
@@ -447,16 +477,23 @@ class TSDB:
                 if kind == "histogram":
                     counts = [float(c) for c in s.get("counts", ())]
                     total = float(s.get("sum", 0.0))
+                    # exemplars ride the sample record ABSOLUTE (a
+                    # handful of slots; delta-encoding evidence pointers
+                    # would buy nothing and cost decode complexity)
+                    ex = s.get("exemplars") or None
                     prev = self._last.get(sid)
                     if prev is not None and len(prev[0]) == len(counts):
-                        dc = [c - p for c, p in zip(counts, prev[0])]
-                        self._append_payload(
-                            {"k": "h", "t": ts_ms, "id": sid, "dc": dc,
-                             "dsum": total - prev[1]})
+                        doc = {"k": "h", "t": ts_ms, "id": sid,
+                               "dc": [c - p for c, p in zip(counts,
+                                                            prev[0])],
+                               "dsum": total - prev[1]}
                     else:
-                        self._append_payload(
-                            {"k": "h", "t": ts_ms, "id": sid, "c": counts,
-                             "sum": total})
+                        doc = {"k": "h", "t": ts_ms, "id": sid,
+                               "c": counts, "sum": total}
+                    if ex and ex != self._last_ex.get(sid):
+                        doc["ex"] = ex
+                        self._last_ex[sid] = ex
+                    self._append_payload(doc)
                     self._last[sid] = (counts, total)
                 else:
                     value = float(s.get("value", 0.0))
@@ -542,13 +579,20 @@ class TSDB:
                 if info.kind == "histogram":
                     ts, counts, total = point
                     if prev is not None and len(prev[0]) == len(counts):
-                        out.append({"k": "h", "t": ts, "id": sid,
-                                    "dc": [c - p for c, p in
-                                           zip(counts, prev[0])],
-                                    "dsum": total - prev[1]})
+                        doc = {"k": "h", "t": ts, "id": sid,
+                               "dc": [c - p for c, p in
+                                      zip(counts, prev[0])],
+                               "dsum": total - prev[1]}
                     else:
-                        out.append({"k": "h", "t": ts, "id": sid,
-                                    "c": list(counts), "sum": total})
+                        doc = {"k": "h", "t": ts, "id": sid,
+                               "c": list(counts), "sum": total}
+                    if prev is None and info.exemplars:
+                        # merged newest-per-bucket slots survive the
+                        # fold; one absolute emission per series is
+                        # enough (decode merges from any record)
+                        doc["ex"] = [list(e) if e else None
+                                     for e in info.exemplars]
+                    out.append(doc)
                     prev = (counts, total)
                 else:
                     ts, value = point
@@ -636,6 +680,9 @@ def _decode_segment(path: str, process: Optional[str] = None,
             series.setdefault(info.key() + ((process,)
                                             if process else ()), info)
             info.points.append((int(r.get("t", 0)), counts, total))
+            if r.get("ex"):
+                info.exemplars = merge_exemplar_slots(info.exemplars,
+                                                      r["ex"])
         elif k == "e":
             events.append((int(r.get("t", 0)), r.get("e") or {}))
         elif k == "tr":
@@ -758,6 +805,9 @@ class TSDBReader:
                     p for p in info.points
                     if (since_ms is None or p[0] >= since_ms)
                     and (until_ms is None or p[0] <= until_ms))
+                if info.exemplars:
+                    out.exemplars = merge_exemplar_slots(out.exemplars,
+                                                         info.exemplars)
         for info in merged.values():
             info.points.sort(key=lambda p: p[0])
         return sorted(merged.values(), key=lambda i: (i.name,

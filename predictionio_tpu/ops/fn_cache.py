@@ -33,16 +33,21 @@ def _attributed(family: str, fn: Callable) -> Callable:
     """Per-family dispatch-time attribution (obs/profiler.py): each call
     of a cached compiled function adds its dispatch wall time to
     ``pio_device_dispatch_seconds_total{family}`` — the "which compiled
-    family is eating the device" answer. One perf_counter pair + one
-    counter add per dispatch; PIO_DISPATCH_ATTRIBUTION=0 skips the wrap
+    family is eating the device" answer — and, when a micro-batch is
+    live, into that batch's anatomy breakdown so requests get their
+    amortized device-dispatch share (obs/anatomy.py). One perf_counter
+    pair + a counter add + a contextvar read per dispatch; with both
+    PIO_DISPATCH_ATTRIBUTION=0 and PIO_ANATOMY=0 the wrap is skipped
     entirely (zero overhead)."""
+    from predictionio_tpu.obs import anatomy
     from predictionio_tpu.obs.profiler import (
         dispatch_attribution_enabled, dispatch_counter,
     )
 
-    if not dispatch_attribution_enabled():
+    attributed = dispatch_attribution_enabled()
+    if not attributed and not anatomy.anatomy_enabled():
         return fn
-    counter = dispatch_counter()
+    counter = dispatch_counter() if attributed else None
 
     @functools.wraps(fn)
     def dispatch(*args, **kwargs):
@@ -50,7 +55,10 @@ def _attributed(family: str, fn: Callable) -> Callable:
         try:
             return fn(*args, **kwargs)
         finally:
-            counter.inc(time.perf_counter() - t0, family=family)
+            dt = time.perf_counter() - t0
+            if counter is not None:
+                counter.inc(dt, family=family)
+            anatomy.note_dispatch(dt)
     return dispatch
 
 

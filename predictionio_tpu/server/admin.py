@@ -224,6 +224,12 @@ def create_admin_server(registry: MetricsRegistry = None,
     app.router.add_delete("/cmd/app/{name}/data", handle_app_data_delete)
     app.router.add_get("/cmd/releases", handle_releases)
     app.router.add_get("/cmd/slo", handle_slo)
+    from predictionio_tpu.obs.capacity import (
+        add_capacity_route, register_capacity_metrics,
+    )
+
+    register_capacity_metrics(registry)
+    add_capacity_route(app)
     add_metrics_routes(app, registry, default_registry())
     # fleet-wide history: the admin answers /history/*.json over the
     # MERGED per-process telemetry stores (obs/fleet.history_reader) —

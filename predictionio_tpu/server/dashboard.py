@@ -34,6 +34,9 @@ from typing import List, Optional
 
 from aiohttp import web
 
+from predictionio_tpu.obs.capacity import (
+    CAPACITY_PATH, add_capacity_route, register_capacity_metrics,
+)
 from predictionio_tpu.obs.middleware import (
     METRICS_PATHS, add_metrics_routes, observability_middleware,
 )
@@ -59,7 +62,8 @@ _SPARK = "▁▂▃▄▅▆▇█"
 
 @web.middleware
 async def _key_auth_middleware(request, handler):
-    if request.path in METRICS_PATHS or request.path in HISTORY_PATHS:
+    if request.path in METRICS_PATHS or request.path in HISTORY_PATHS \
+            or request.path == CAPACITY_PATH:
         return await handler(request)   # scrapers hold no access keys
     cfg = request.app[_SERVER_CONFIG]
     if not cfg.check_key(request.query.get("accessKey")):
@@ -274,6 +278,51 @@ def _serving_rows(reader, since_ms: int) -> List[List[str]]:
     return rows
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.0f}B" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _capacity_rows(reader, since_ms: int) -> List[List[str]]:
+    """Per-process capacity ledger: live device bytes (+history), the
+    process watermark, host RSS, and the per-role unit residency."""
+    last, spark = {}, {}
+    for name in ("pio_capacity_device_bytes",
+                 "pio_capacity_device_watermark_bytes",
+                 "pio_capacity_host_rss_bytes"):
+        for info in reader.series(name, since_ms=since_ms):
+            if not info.points:
+                continue
+            proc = info.labels.get("process", "")
+            last[(proc, name)] = info.points[-1][1]
+            if name == "pio_capacity_device_bytes":
+                spark[proc] = sparkline([p[1] for p in info.points])
+    units: dict = {}
+    for info in reader.series("pio_capacity_unit_resident_bytes",
+                              since_ms=since_ms):
+        if not info.points:
+            continue
+        proc = info.labels.get("process", "")
+        units.setdefault(proc, []).append(
+            f"{info.labels.get('role', '?')}="
+            f"{_fmt_bytes(info.points[-1][1])}")
+    rows = []
+    for proc in sorted({p for p, _n in last}):
+        rows.append([
+            _esc(proc),
+            _fmt_bytes(last.get((proc, "pio_capacity_device_bytes"), 0.0)),
+            _fmt_bytes(last.get(
+                (proc, "pio_capacity_device_watermark_bytes"), 0.0)),
+            _fmt_bytes(last.get((proc, "pio_capacity_host_rss_bytes"),
+                                0.0)),
+            _esc(", ".join(sorted(units.get(proc, []))) or "-"),
+            f"<code>{spark.get(proc, '')}</code>"])
+    return rows
+
+
 def _evaluation_rows() -> List[List[str]]:
     try:
         instances = \
@@ -305,6 +354,12 @@ def render_console(reader, orch_state_dir: Optional[str],
             ["process", "variant", "queries", "throughput history",
              "p99 over window"], _serving_rows(reader, since_ms),
             empty="no persisted serving history")),
+        _section("Capacity ledger (trailing hour)", _table(
+            ["process", "device bytes", "watermark", "host RSS",
+             "unit residency", "device history"],
+            _capacity_rows(reader, since_ms),
+            empty="no persisted capacity history — /capacity.json "
+                  "answers live per process")),
         _section("Orchestrator cycles", _table(
             ["cycle", "trigger", "started", "wall", "last phase",
              "outcome", "release", "reason"],
@@ -408,6 +463,8 @@ def create_dashboard(server_config: Optional[ServerConfig] = None,
     app.router.add_get("/engine_instances/{instance_id}", handle_detail)
     app.router.add_get("/evaluations.json", handle_index_json)
     app.router.add_get("/evaluations/{instance_id}.json", handle_detail_json)
+    register_capacity_metrics(registry)
+    add_capacity_route(app)
     add_metrics_routes(app, registry, default_registry())
     add_history_routes(app, app[_READER_FACTORY])
     if telemetry is not None:

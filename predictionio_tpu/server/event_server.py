@@ -89,6 +89,9 @@ class EventServer:
                 backoff_s=ic.backoff_s, backoff_cap_s=ic.backoff_cap_s,
                 flush_timeout_s=ic.flush_timeout_s, registry=self.registry)
         self.stats = Stats(registry=self.registry)
+        from predictionio_tpu.obs.capacity import register_capacity_metrics
+
+        register_capacity_metrics(self.registry)
         self._ingest_total = self.registry.counter(
             "pio_event_ingest_total",
             "Event ingest attempts by response status",
@@ -173,6 +176,9 @@ class EventServer:
         r.add_post("/webhooks/{name}.json", self.handle_webhook_post)
         r.add_get("/webhooks/{name}.json", self.handle_webhook_get)
         add_metrics_routes(self.app, self.registry, default_registry())
+        from predictionio_tpu.obs.capacity import add_capacity_route
+
+        add_capacity_route(self.app)
         from predictionio_tpu.obs.telemetry import (
             add_history_routes, history_reader_factory,
         )

@@ -66,6 +66,14 @@ from predictionio_tpu.deploy.warm import (
     DeployError, FoldinSwapRaced, ServingUnit, WarmupReport, build_unit,
     deploy_metrics, verify_unit, warmup_unit,
 )
+from predictionio_tpu.obs.anatomy import (
+    SERVING_PATH, AnatomyMetrics, BatchBreakdown, active_breakdown,
+    anatomy_enabled, anatomy_metrics, note_stage, observe_serving_batch,
+    observe_stage, pop_breakdown, push_breakdown,
+)
+from predictionio_tpu.obs.capacity import (
+    add_capacity_route, register_capacity_metrics, unit_capacity,
+)
 from predictionio_tpu.obs.jax_stats import register_jax_metrics
 from predictionio_tpu.obs.middleware import add_metrics_routes, observability_middleware
 from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
@@ -114,6 +122,9 @@ def _stage(hist, name: str):
         trace = current_trace()
         if trace is not None:
             trace.add(name, dt)
+        # and into the active batch's anatomy breakdown (no-op outside
+        # a micro-batch) so members get their per-request stage share
+        note_stage(name, dt)
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -193,7 +204,9 @@ class MicroBatcher:
         self._last_arrival: Optional[float] = None
         self._registry = registry
         self._size_hist = self._inflight_gauge = self._span_hist = None
+        self._anatomy: Optional[AnatomyMetrics] = None
         if registry is not None:
+            self._anatomy = anatomy_metrics(registry)
             self._size_hist = registry.histogram(
                 "pio_batch_size",
                 "Queries coalesced per micro-batch drain",
@@ -262,8 +275,12 @@ class MicroBatcher:
         # capture the submitting request's trace context so the executor
         # thread's batch spans stay linked to it (the thread hop used to
         # drop the contextvar trace); a cheap contextvar read, None when
-        # tracing is off
-        entry = (query, fut, capture_context())
+        # tracing is off. The submit timestamp + the request's own Trace
+        # feed the per-request anatomy (queue wait per member, stages
+        # attached to EACH member's trace — not just the first
+        # submitter's carried one).
+        entry = (query, fut, capture_context(), time.perf_counter(),
+                 current_trace())
         while True:
             if self._task is None or self._task.done():
                 self._queue = asyncio.Queue()
@@ -295,23 +312,30 @@ class MicroBatcher:
                     while len(batch) < self.max_batch and not queue.empty():
                         batch.append(queue.get_nowait())
                     linger = self._linger_window()
+                    linger_dt = 0.0
                     if linger > 0.0 and len(batch) < self.max_batch:
                         t0 = time.perf_counter()
                         await asyncio.sleep(linger)
-                        self._observe_span("batch_linger",
-                                           time.perf_counter() - t0)
+                        linger_dt = time.perf_counter() - t0
+                        self._observe_span("batch_linger", linger_dt)
                         while (len(batch) < self.max_batch
                                and not queue.empty()):
                             batch.append(queue.get_nowait())
                     if self._size_hist is not None:
                         self._size_hist.observe(float(len(batch)))
-                    queries = [q for q, _, _ in batch]
+                    queries = [entry[0] for entry in batch]
                     # the batch runs under the FIRST traced submitter's
                     # context (coalesced siblings ride the same batch)
-                    ctx = next((c for _, _, c in batch if c is not None),
-                               None)
+                    ctx = next((entry[2] for entry in batch
+                                if entry[2] is not None), None)
+                    # (submit perf_counter, submitter Trace) per member —
+                    # the anatomy observation at batch end amortizes from
+                    # these
+                    meta = [(entry[3], entry[4]) for entry in batch]
+                    t_dispatch = time.perf_counter()
                     ex_fut = loop.run_in_executor(
-                        self._executor, self._run_batch, queries, ctx)
+                        self._executor, self._run_batch, queries, ctx,
+                        meta, linger_dt, t_dispatch)
                     self._inflight_now += 1
                     if self._inflight_gauge is not None:
                         self._inflight_gauge.set(float(self._inflight_now))
@@ -329,22 +353,45 @@ class MicroBatcher:
             # their executor-future callbacks
             while not queue.empty():
                 batch.append(queue.get_nowait())
-            for _, fut, _ in batch:
+            for entry in batch:
+                fut = entry[1]
                 if not fut.done():
                     fut.set_exception(
                         RuntimeError("query micro-batch worker stopped"))
 
-    def _run_batch(self, queries, ctx):
+    def _run_batch(self, queries, ctx, meta=(), linger_s=0.0,
+                   t_dispatch=0.0):
         """Executor-side batch dispatch, re-entering the submitting
         request's trace when one was captured — the serving_batch hop
         (and its batch_* stage spans) land in the flight recorder under
         the request's trace id."""
         if ctx is None:
-            return self._predict_batch(queries)
+            return self._run_measured(queries, meta, linger_s, t_dispatch)
         with carried(ctx, "serving_batch", registry=self._registry,
                      span_hist=self._span_hist,
                      attrs={"batch": len(queries)}):
+            return self._run_measured(queries, meta, linger_s, t_dispatch)
+
+    def _run_measured(self, queries, meta, linger_s, t_dispatch):
+        """Run the batch under an anatomy breakdown: the predict path's
+        _stage blocks, the padding geometry, and the fn_cache dispatch
+        wrapper fill it, and each member's per-request stage share is
+        observed when the batch completes — before the futures resolve,
+        so the stages are on the trace when the middleware records it."""
+        if self._anatomy is None or not anatomy_enabled():
             return self._predict_batch(queries)
+        bd = BatchBreakdown()
+        token = push_breakdown(bd)
+        try:
+            results = self._predict_batch(queries)
+        finally:
+            pop_breakdown(token)
+        try:
+            observe_serving_batch(self._anatomy, bd, meta, linger_s,
+                                  t_dispatch)
+        except Exception:
+            logger.exception("anatomy observation failed")
+        return results
 
     def _finish_batch(self, batch, sem: asyncio.Semaphore, ex_fut) -> None:
         """Runs on the event loop when a dispatched batch's executor
@@ -360,7 +407,8 @@ class MicroBatcher:
             err = e if isinstance(e, Exception) else \
                 RuntimeError(f"micro-batch dispatch failed: {e!r}")
             results = [err] * len(batch)
-        for (_, fut, _), res in zip(batch, results):
+        for entry, res in zip(batch, results):
+            fut = entry[1]
             if fut.done():
                 continue
             if isinstance(res, Exception):
@@ -456,6 +504,10 @@ class QueryServer:
         #: (_predict_batch runs per batch on the executor — it must not
         #: take the registry lock to re-resolve the histogram each stage)
         self._span_hist = span_histogram(self.registry)
+        #: anatomy stage histograms (serialize stage observes per request)
+        self._anatomy = anatomy_metrics(self.registry)
+        #: capacity ledger: per-unit residency gauge walks the live units
+        register_capacity_metrics(self.registry, self._capacity_units)
         self._pad_waste = self.registry.counter(
             "pio_batch_pad_waste_rows_total",
             "Throwaway rows added padding batches up to their shape "
@@ -617,6 +669,7 @@ class QueryServer:
         r.add_post("/rollback.json", self.handle_rollback)
         r.add_get("/slo.json", self.handle_slo)
         r.add_post("/debug/profile", self.handle_profile)
+        add_capacity_route(self.app, self._capacity_units)
         add_metrics_routes(self.app, self.registry, default_registry())
         from predictionio_tpu.obs.telemetry import (
             add_history_routes, history_reader_factory,
@@ -674,6 +727,19 @@ class QueryServer:
         units = [self._unit]
         if self._canary is not None:
             units.append(self._canary.unit)
+        return units
+
+    def _capacity_units(self) -> List[dict]:
+        """Per-unit residency roll-up for /capacity.json and the
+        pio_capacity_unit_resident_bytes gauge: the active unit, the
+        blue/green standby kept resident for instant rollback, and a
+        staged canary — the exact set the memory budgeter must account."""
+        units = [unit_capacity(self._unit, "active")]
+        if self._standby is not None:
+            units.append(unit_capacity(self._standby, "standby"))
+        canary = self._canary
+        if canary is not None:
+            units.append(unit_capacity(canary.unit, "canary"))
         return units
 
     def _spawn(self, coro) -> None:
@@ -767,6 +833,7 @@ class QueryServer:
             return web.json_response({"message": str(e)}, status=400)
         self._observe_role(canary, role,
                            time.perf_counter() - t_predict, ok=True)
+        t_serialize = time.perf_counter()
         if (canary is not None and canary.config.shadow
                 and canary.controller.decided is None):
             # shadow mode: mirror the query into the candidate off the
@@ -795,6 +862,12 @@ class QueryServer:
             except Exception:
                 logger.exception("output sniffer failed")
 
+        if anatomy_enabled():
+            # the post-predict tail: feedback scheduling, blockers,
+            # sniffers, JSON conversion — the "serialize" anatomy stage
+            observe_stage(self._anatomy, SERVING_PATH, "serialize",
+                          time.perf_counter() - t_serialize,
+                          current_trace())
         dt = time.perf_counter() - t0
         self.last_serving_sec = dt
         self._query_hist.observe(dt, engine_variant=variant)
@@ -912,6 +985,10 @@ class QueryServer:
                 return out
             bucket = bucket_size(len(ok), self.serving_config.batch_max)
             waste = padding_waste(len(ok), bucket)
+            bd = active_breakdown()
+            if bd is not None:
+                # pad geometry for the per-member pad_share attribution
+                bd.note_padding(len(ok), waste, bucket)
             if waste:
                 # sentinel indices >= n mark pad rows; their predictions
                 # are computed and thrown away — the bounded price of a

@@ -72,7 +72,9 @@ def test_bench_serving_batching_smoke(tmp_path):
                         # exercised here, not the bound
                         "BENCH_OBS_REPEATS": "1",
                         "BENCH_OBS_OVERHEAD_PCT": "10000",
-                        "BENCH_OBS_OVERHEAD_ABS_MS": "1000"})
+                        "BENCH_OBS_OVERHEAD_ABS_MS": "1000",
+                        "BENCH_ANATOMY_OVERHEAD_PCT": "10000",
+                        "BENCH_ANATOMY_OVERHEAD_ABS_MS": "1000"})
     assert p.returncode == 0, p.stderr[-2000:]
     lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
@@ -85,6 +87,8 @@ def test_bench_serving_batching_smoke(tmp_path):
                 "p99_ms_8c_single_inflight",
                 "p99_ms_8c_obs_on", "p99_ms_8c_obs_off",
                 "obs_overhead_pct",
+                "p99_ms_8c_anatomy_on", "p99_ms_8c_anatomy_off",
+                "anatomy_overhead_pct",
                 "distinct_compiled_batch_shapes", "compile_shape_bound"):
         assert key in detail, (key, detail)
     assert 0 < detail["distinct_compiled_batch_shapes"] \

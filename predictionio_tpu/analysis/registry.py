@@ -61,6 +61,11 @@ KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
     "PIO_ENTITY_CACHE_TTL_S": ("predictionio_tpu/engines/common.py",),
     "PIO_TPU_SOLVE": ("predictionio_tpu/ops/linalg.py",),
     "PIO_INGEST_CACHE": ("predictionio_tpu/data/ingest.py",),
+    # partition count must bind identically for the server (lane count,
+    # via IngestConfig) AND for offline CLI tools that open the store
+    # with no server config — so the storage registry reads it directly;
+    # the committed partition map on disk stays authoritative
+    "PIO_INGEST_PARTITIONS": ("predictionio_tpu/storage/registry.py",),
     "PIO_VIEW_CACHE_DIR": ("predictionio_tpu/data/view.py",),
     # read only by the test suite (documented, so registered)
     "PIO_TEST_POSTGRES_URL": ("tests/",),
@@ -155,9 +160,15 @@ SEGMENT_WRITE_HELPERS: Dict[str, Tuple[str, ...]] = {
     "predictionio_tpu/obs/tsdb.py": (
         "_append_payload", "_commit_file", "_ensure_active"),
     "predictionio_tpu/obs/telemetry.py": (),
+    # the shared log-structured substrate (PR 17): the committed-rewrite
+    # and staged-commit primitives both segment disciplines ride — every
+    # write here performs its own rename commit
+    "predictionio_tpu/storage/logstore.py": (
+        "commit_file", "fs_commit_stream", "fs_commit_bytes"),
 }
 # (_claim_dir commits the WRITER pid file THROUGH _commit_file, so it
-# needs no entry of its own)
+# needs no entry of its own; tsdb._commit_file delegates to
+# logstore.commit_file and keeps its registered name for the discipline)
 
 # -- PIO003: trace-plane carriers --------------------------------------------
 

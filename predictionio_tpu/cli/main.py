@@ -1488,6 +1488,41 @@ def compact_cmd(appid, appname, channel, ttl_days):
                + ": " + json.dumps(stats, sort_keys=True))
 
 
+@cli.command("reshard")
+@click.option("--partitions", "-p", type=int, required=True,
+              help="New partition count for the event store.")
+def reshard_cmd(partitions):
+    """Change the partitioned event store's partition count.
+
+    Copies every app/channel namespace into a new generation of
+    partition stores (idempotent inserts, original event ids), commits
+    the partition map atomically, then collects the old generation —
+    exactly-once at every crash point; an interrupted run can simply be
+    re-run. Offline maintenance: stop event servers first (like
+    `pio compact`, one operator at a time)."""
+    from predictionio_tpu.storage import Storage, StorageError
+
+    store = Storage.get_events()
+    if not hasattr(store, "reshard"):
+        click.echo(
+            "[ERROR] the configured event store is not partitioned. "
+            "Set PIO_INGEST_PARTITIONS>1 on a sqlite or parquet "
+            "EVENTDATA source to create one.")
+        sys.exit(1)
+    apps = []
+    for app in Storage.get_meta_data_apps().get_all():
+        apps.append((app.id, None))
+        for ch in Storage.get_meta_data_channels().get_by_appid(app.id):
+            apps.append((app.id, ch.id))
+    try:
+        stats = store.reshard(partitions, apps)
+    except StorageError as e:
+        click.echo(f"[ERROR] reshard failed (safe to re-run): {e}")
+        sys.exit(1)
+    click.echo(f"[INFO] Resharded {len(apps)} namespace(s): "
+               + json.dumps(stats, sort_keys=True))
+
+
 # ---------------------------------------------------------------------------
 # servers
 # ---------------------------------------------------------------------------

@@ -141,6 +141,14 @@ def training_scan(app_name: str, channel_name: Optional[str] = None, *,
     captures ``read_snapshot()`` once, broadcasts it, and every process
     scans only its partition of that window. Engines whose algorithms do
     NOT exchange rows by owner must keep the default replicated read.
+
+    On a partitioned event store (`PIO_INGEST_PARTITIONS`,
+    storage/partitioned.py) both paths gain partition parallelism for
+    free at the store layer: the unsharded scan fans per-partition
+    reads across a thread pool and merges time-ordered, and the
+    sharded read maps reader shards onto store partitions
+    (`shard_partitions`) under a composite snapshot — a reshard
+    between capture and read fails loudly instead of skewing.
     """
     from predictionio_tpu.data.eventstore import EventStoreClient
 

@@ -30,6 +30,18 @@ Three mechanisms:
   ``flush_timeout_s`` (the attempt runs on its own thread) and retried
   the same way.
 
+**Commit lanes** (PR 17): with ``partitions > 1`` the buffer runs one
+group-commit lane PER PARTITION — its own bounded queue, writer thread
+and flush stream — routed by the same stable entity hash the
+partitioned store uses (storage/partitioned.partition_of), so a lane's
+flush lands in exactly one partition's commit stream and the P
+partitions commit in parallel. Backpressure is per lane
+(``queue_max // partitions`` events each) and the 429 ``Retry-After``
+estimate comes from THAT lane's observed flush time — one slow
+partition no longer inflates backoff for writers of healthy ones. A
+submit whose events span lanes is split and its ids reassembled in
+input order; acknowledgment still means every split part committed.
+
 ``stop(drain=True)`` flushes everything still queued before returning —
 the aiohttp ``on_shutdown`` hook uses it so buffered events are never
 dropped by a graceful restart.
@@ -38,6 +50,8 @@ dropped by a graceful restart.
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
+import functools
 import logging
 import threading
 import time
@@ -52,6 +66,7 @@ from predictionio_tpu.obs.tracing import (
     capture_context, carried, current_trace, span,
 )
 from predictionio_tpu.storage.base import StorageError, generate_id
+from predictionio_tpu.storage.partitioned import partition_of
 from predictionio_tpu.utils.retry import RetryPolicy, start_attempt_thread
 
 logger = logging.getLogger("pio.writebuffer")
@@ -99,7 +114,8 @@ class BufferFull(Exception):
     """The bounded ingest queue cannot accept more events right now.
 
     ``retry_after`` is a seconds estimate of when capacity should free up
-    (queue depth over the recently observed flush rate), for the
+    (queue depth over the recently observed flush rate OF THE LANE that
+    shed — a slow partition backs off only its own writers), for the
     ``Retry-After`` response header.
     """
 
@@ -152,6 +168,62 @@ class _Pending:
         self.req_trace = req_trace
 
 
+class _Lane:
+    """One commit lane: bounded queue + writer thread + flush clock.
+
+    Every field is guarded by the lane's own condition variable, so the
+    P lanes never contend on a shared lock — the point of the split."""
+
+    __slots__ = ("index", "cond", "queue", "depth", "thread",
+                 "last_flush_s")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.cond = threading.Condition()
+        self.queue: deque = deque()
+        self.depth = 0          # queued + in-flush events (memory bound)
+        self.thread: Optional[threading.Thread] = None
+        self.last_flush_s = 0.05   # seeds the retry-after estimate
+
+
+def _join_parts(parent: "concurrent.futures.Future", n_events: int,
+                parts) -> None:
+    """Assemble a split (multi-lane) submit's parent future from its
+    per-lane children: ids land back at their input positions; the first
+    failed part fails the parent (the caller must treat a failed ack as
+    ambiguous and retry idempotently, exactly as for one lane)."""
+    ids: List[Optional[str]] = [None] * n_events
+    state = {"remaining": len(parts), "failed": False}
+    lock = threading.Lock()
+
+    def one_done(idxs, child):
+        exc = child.exception()
+        res = child.result() if exc is None else None
+        finish = None
+        with lock:
+            if state["failed"]:
+                return
+            if exc is not None:
+                state["failed"] = True
+                finish = ("exc", exc)
+            else:
+                for i, eid in zip(idxs, res):
+                    ids[i] = eid
+                state["remaining"] -= 1
+                if state["remaining"] == 0:
+                    finish = ("ok", None)
+        if finish is None:
+            return
+        if parent.set_running_or_notify_cancel():
+            if finish[0] == "exc":
+                parent.set_exception(finish[1])
+            else:
+                parent.set_result(ids)
+
+    for idxs, child in parts:
+        child.add_done_callback(functools.partial(one_done, idxs))
+
+
 class WriteBuffer:
     """Bounded group-commit buffer in front of an EventStore."""
 
@@ -159,7 +231,8 @@ class WriteBuffer:
                  queue_max: int = 8192, flush_max: int = 256,
                  linger_s: float = 0.002, retries: int = 4,
                  backoff_s: float = 0.05, backoff_cap_s: float = 1.0,
-                 flush_timeout_s: float = 30.0, registry=None):
+                 flush_timeout_s: float = 30.0, partitions: int = 1,
+                 registry=None):
         if store_fn is None:
             from predictionio_tpu.storage.registry import Storage
 
@@ -172,17 +245,16 @@ class WriteBuffer:
         self.backoff_s = backoff_s
         self.backoff_cap_s = backoff_cap_s
         self.flush_timeout_s = flush_timeout_s
+        self.partitions = max(1, partitions)
+        #: per-lane event bound — total capacity stays queue_max
+        self.lane_queue_max = max(1, self.queue_max // self.partitions)
 
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
-        self._queue: deque[_Pending] = deque()
-        self._depth = 0            # queued + in-flush events (memory bound)
+        self._lanes = [_Lane(i) for i in range(self.partitions)]
         self._stopping = False
-        self._thread: Optional[threading.Thread] = None
-        self._last_flush_s = 0.05  # seeds the retry-after estimate
 
         self._shed_total = self._retry_total = None
         self._flush_size = self._flush_duration = None
+        self._p_flush_size = self._p_commit = None
         self._anatomy = None
         self._registry = registry
         if registry is not None:
@@ -191,6 +263,12 @@ class WriteBuffer:
                 "pio_ingest_queue_depth",
                 "Events buffered for group commit (queued + in flush)",
                 lambda: float(self.queue_depth()))
+            registry.gauge_callback(
+                "pio_ingest_partition_queue_depth",
+                "Events buffered per commit lane (queued + in flush)",
+                lambda: [({"partition": str(lane.index)}, float(lane.depth))
+                         for lane in self._lanes],
+                labelnames=("partition",))
             self._shed_total = registry.counter(
                 "pio_ingest_shed_total",
                 "Events rejected with 429 because the ingest queue was full")
@@ -202,16 +280,27 @@ class WriteBuffer:
                 "Events per group-commit flush",
                 buckets=(1., 2., 4., 8., 16., 32., 64., 128., 256., 512.,
                          1024.))
+            self._p_flush_size = registry.histogram(
+                "pio_ingest_partition_flush_size",
+                "Events per group-commit flush, by commit lane",
+                labelnames=("partition",),
+                buckets=(1., 2., 4., 8., 16., 32., 64., 128., 256., 512.,
+                         1024.))
             self._flush_duration = registry.histogram(
                 "pio_ingest_flush_duration_seconds",
                 "Wall time of one group-commit flush (including retries)")
+            self._p_commit = registry.histogram(
+                "pio_ingest_partition_commit_seconds",
+                "Durable commit wall time of one lane flush, by commit "
+                "lane (the anatomy `commit` stage, partition-resolved)",
+                labelnames=("partition",))
 
     # -- caller side ---------------------------------------------------------
     def queue_depth(self) -> int:
-        return self._depth
+        return sum(lane.depth for lane in self._lanes)
 
-    def _retry_after(self, depth: int) -> int:
-        est = (depth / self.flush_max) * self._last_flush_s
+    def _retry_after(self, lane: _Lane) -> int:
+        est = (lane.depth / self.flush_max) * lane.last_flush_s
         return int(min(60, max(1, est + 0.999)))
 
     def submit(self, events: Sequence[Event], app_id: int,
@@ -220,38 +309,89 @@ class WriteBuffer:
         """Queue events for group commit; returns a future of their ids.
 
         Ids are assigned HERE (idempotency token for the retrying flush).
-        Raises :class:`BufferFull` instead of queueing past ``queue_max``.
-        """
+        Raises :class:`BufferFull` instead of queueing past the target
+        lane's bound. Multi-partition buffers route each event to its
+        entity's lane; a submit that spans lanes reserves capacity on
+        every target lane atomically (all queued or none) and returns a
+        future that resolves when every part committed."""
         events = [e if e.event_id else _with_id(e) for e in events]
-        future: concurrent.futures.Future = concurrent.futures.Future()
-        with self._cond:
+        if self.partitions == 1 or len(events) == 0:
+            return self._submit_lane(self._lanes[0], events, app_id,
+                                     channel_id)
+        groups: dict = {}
+        for i, e in enumerate(events):
+            p = partition_of(app_id, channel_id, e.entity_id,
+                             self.partitions)
+            idxs, evs = groups.setdefault(p, ([], []))
+            idxs.append(i)
+            evs.append(e)
+        if len(groups) == 1:
+            ((p, (_, evs)),) = groups.items()
+            return self._submit_lane(self._lanes[p], evs, app_id,
+                                     channel_id)
+        parent: concurrent.futures.Future = concurrent.futures.Future()
+        parts = []
+        # lanes locked in index order (consistent order -> no deadlock
+        # against a concurrent spanning submit)
+        lane_ids = sorted(groups)
+        with contextlib.ExitStack() as stack:
+            for p in lane_ids:
+                stack.enter_context(self._lanes[p].cond)
             if self._stopping:
                 raise StorageError("write buffer is shut down")
-            if self._depth + len(events) > self.queue_max:
+            for p in lane_ids:
+                lane = self._lanes[p]
+                if lane.depth + len(groups[p][1]) > self.lane_queue_max:
+                    if self._shed_total is not None:
+                        self._shed_total.inc(len(events))
+                    raise BufferFull(lane.depth, self._retry_after(lane))
+            for p in lane_ids:
+                idxs, evs = groups[p]
+                child: concurrent.futures.Future = \
+                    concurrent.futures.Future()
+                self._enqueue_locked(self._lanes[p], evs, app_id,
+                                     channel_id, child)
+                parts.append((idxs, child))
+        _join_parts(parent, len(events), parts)
+        return parent
+
+    def _submit_lane(self, lane: _Lane, events, app_id, channel_id
+                     ) -> "concurrent.futures.Future[List[str]]":
+        future: concurrent.futures.Future = concurrent.futures.Future()
+        with lane.cond:
+            if self._stopping:
+                raise StorageError("write buffer is shut down")
+            if lane.depth + len(events) > self.lane_queue_max:
                 if self._shed_total is not None:
                     self._shed_total.inc(len(events))
-                raise BufferFull(self._depth, self._retry_after(self._depth))
-            self._queue.append(_Pending(events, app_id, channel_id, future,
-                                        trace=capture_context(),
-                                        t_submit=time.perf_counter(),
-                                        req_trace=current_trace()))
-            self._depth += len(events)
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._worker, daemon=True, name="pio-ingest-writer")
-                self._thread.start()
-            self._cond.notify()
+                raise BufferFull(lane.depth, self._retry_after(lane))
+            self._enqueue_locked(lane, events, app_id, channel_id, future)
         return future
 
+    def _enqueue_locked(self, lane: _Lane, events, app_id, channel_id,
+                        future) -> None:
+        """Append one pending submit to a lane. Caller holds lane.cond."""
+        lane.queue.append(_Pending(events, app_id, channel_id, future,
+                                   trace=capture_context(),
+                                   t_submit=time.perf_counter(),
+                                   req_trace=current_trace()))
+        lane.depth += len(events)
+        if lane.thread is None:
+            lane.thread = threading.Thread(
+                target=self._worker, args=(lane,), daemon=True,
+                name=f"pio-ingest-writer-{lane.index}")
+            lane.thread.start()
+        lane.cond.notify()
+
     # -- writer side ---------------------------------------------------------
-    def _worker(self) -> None:
+    def _worker(self, lane: _Lane) -> None:
         while True:
-            with self._cond:
-                while not self._queue and not self._stopping:
-                    self._cond.wait()
-                if not self._queue and self._stopping:
+            with lane.cond:
+                while not lane.queue and not self._stopping:
+                    lane.cond.wait()
+                if not lane.queue and self._stopping:
                     return
-                batch = [self._queue.popleft()]
+                batch = [lane.queue.popleft()]
                 total = len(batch[0].events)
                 # linger: hold the first events briefly so concurrent
                 # submits coalesce — but never once the flush is full.
@@ -260,26 +400,28 @@ class WriteBuffer:
                 # drain as per-request flushes and blow the stop timeout.
                 deadline = time.monotonic() + self.linger_s
                 while total < self.flush_max:
-                    if self._queue:
-                        batch.append(self._queue.popleft())
+                    if lane.queue:
+                        batch.append(lane.queue.popleft())
                         total += len(batch[-1].events)
                         continue
                     if self._stopping:
                         break
                     remaining = deadline - time.monotonic()
-                    if remaining <= 0 or not self._cond.wait(remaining):
+                    if remaining <= 0 or not lane.cond.wait(remaining):
                         break
             try:
-                self._flush(batch, total)
+                self._flush(lane, batch, total)
             finally:
-                with self._cond:
-                    self._depth -= total
+                with lane.cond:
+                    lane.depth -= total
 
-    def _flush(self, batch: List[_Pending], total: int) -> None:
+    def _flush(self, lane: _Lane, batch: List[_Pending],
+               total: int) -> None:
         """One group commit: per-(app, channel) insert_batch with retries."""
         t0 = time.monotonic()
         if self._flush_size is not None:
             self._flush_size.observe(total)
+            self._p_flush_size.observe(total, partition=str(lane.index))
         groups: dict = {}
         for p in batch:
             groups.setdefault((p.app_id, p.channel_id), []).append(p)
@@ -297,13 +439,17 @@ class WriteBuffer:
                         e if isinstance(e, StorageError)
                         else StorageError(str(e)))
                 continue
+            commit_s = time.perf_counter() - t_flush_start
+            if self._p_commit is not None:
+                self._p_commit.observe(commit_s,
+                                       partition=str(lane.index))
             if self._anatomy is not None and anatomy_enabled():
                 try:
                     observe_ingest_batch(
                         self._anatomy,
                         [(p.t_submit, p.req_trace) for p in pendings],
                         t_flush_start,
-                        time.perf_counter() - t_flush_start)
+                        commit_s)
                 except Exception:
                     logger.exception("ingest anatomy observation failed")
             pos = 0
@@ -316,8 +462,9 @@ class WriteBuffer:
             # (online fold-in): only AFTER the durable commit, so a tap
             # can never observe an event the store might still lose
             _notify_taps(events, app_id, channel_id)
-        # feed the Retry-After estimate with the observed flush time
-        self._last_flush_s = max(0.001, time.monotonic() - t0)
+        # feed THIS lane's Retry-After estimate with its observed flush
+        # time — a slow partition backs off only its own writers
+        lane.last_flush_s = max(0.001, time.monotonic() - t0)
         if self._flush_duration is not None:
             self._flush_duration.observe(time.monotonic() - t0)
 
@@ -404,22 +551,28 @@ class WriteBuffer:
 
     # -- lifecycle -----------------------------------------------------------
     def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
-        """Stop the writer. ``drain=True`` flushes everything still queued
+        """Stop the writers. ``drain=True`` flushes everything still queued
         first (the graceful-shutdown contract: accepted events are never
-        dropped); ``drain=False`` fails pending futures immediately."""
-        with self._cond:
-            self._stopping = True
-            if not drain:
-                dropped, self._queue = list(self._queue), deque()
-                for p in dropped:
-                    self._depth -= len(p.events)
-                    if p.future.set_running_or_notify_cancel():
-                        p.future.set_exception(
-                            StorageError("write buffer stopped before flush"))
-            thread = self._thread
-            self._cond.notify_all()
-        if thread is not None:
-            thread.join(timeout=timeout_s)
+        dropped); ``drain=False`` fails pending futures immediately.
+        Lanes drain in parallel; the timeout bounds the whole stop."""
+        threads = []
+        for lane in self._lanes:
+            with lane.cond:
+                self._stopping = True
+                if not drain:
+                    dropped, lane.queue = list(lane.queue), deque()
+                    for p in dropped:
+                        lane.depth -= len(p.events)
+                        if p.future.set_running_or_notify_cancel():
+                            p.future.set_exception(StorageError(
+                                "write buffer stopped before flush"))
+                threads.append(lane.thread)
+                lane.cond.notify_all()
+        deadline = time.monotonic() + timeout_s
+        for thread in threads:
+            if thread is None:
+                continue
+            thread.join(timeout=max(0.0, deadline - time.monotonic()))
             if thread.is_alive():
                 logger.warning("ingest writer did not drain within %.1fs",
                                timeout_s)

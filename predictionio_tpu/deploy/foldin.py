@@ -24,7 +24,11 @@ Event delta collection is push-first, pull-fallback:
   event-time watermark) catches events ingested by OTHER processes;
   push and pull overlap by design and a bounded seen-id set dedups
   them. (Caveat: backdated ``eventTime``s are only caught by push — the
-  pull scan indexes on event time.)
+  pull scan indexes on event time.) On a partitioned event store
+  (``PIO_INGEST_PARTITIONS``, storage/partitioned.py) the pull scan
+  reads the partitions concurrently and merges time-ordered at the
+  store layer, and each dirty entity's full-history read routes to
+  exactly one partition (events hash by entity).
 
 Each apply tick: pull, take up to ``max_pending`` dirty entities, read
 each one's FULL event history through the columnar find path (the solve

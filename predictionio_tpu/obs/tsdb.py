@@ -41,6 +41,13 @@ names the input segments it ``replaces``; recovery (and readers) drop
 replaced segments, so a kill between the compaction commit and the
 input unlink duplicates nothing.
 
+The record framing and the committed-rewrite primitive are the shared
+log-structured substrate (``storage/logstore.py`` — the same machinery
+under parquet compaction manifests and partitioned-store reshards);
+this module re-exports ``pack_record``/``iter_record_payloads``/
+``scan_records`` for its readers and keeps the tsdb-specific pieces
+(segment naming, the WRITER claim, delta encoding, kill points) here.
+
 **Concurrency.** One writer per directory — the telemetry recorder
 thread owns all mutation (no internal locks: a lock held across file
 I/O in obs/ is exactly what PIO004 exists to flag). Readers
@@ -54,17 +61,14 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import struct
 import time
-import zlib
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
+from predictionio_tpu.storage import logstore
 from predictionio_tpu.storage.faults import maybe_kill
-
-#: record header: payload byte length + crc32(payload)
-_HEADER = struct.Struct(">II")
-#: reject absurd lengths when scanning a (possibly garbage) tail
-MAX_RECORD_BYTES = 1 << 24
+from predictionio_tpu.storage.logstore import (   # noqa: F401 — public API
+    MAX_RECORD_BYTES, iter_record_payloads, pack_record, scan_records,
+)
 
 ACTIVE_PREFIX = "active-"
 SEALED_PREFIX = "seg-"
@@ -79,53 +83,6 @@ DEFAULT_SEGMENT_MAX_BYTES = 4 << 20
 DEFAULT_SEGMENT_MAX_AGE_S = 3600.0
 #: compaction folds sealed segments once this many have accumulated
 DEFAULT_COMPACT_MIN_SEGMENTS = 4
-
-
-def pack_record(payload: bytes) -> bytes:
-    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
-
-
-def iter_record_payloads(raw: bytes) -> Iterator[bytes]:
-    """Whole, checksum-clean record payloads from a segment's bytes.
-    Stops silently at the first torn/garbage record — the crash-safety
-    contract: a reader can never surface a partial record."""
-    off, n = 0, len(raw)
-    while off + _HEADER.size <= n:
-        length, crc = _HEADER.unpack_from(raw, off)
-        if length > MAX_RECORD_BYTES:
-            return
-        start = off + _HEADER.size
-        end = start + length
-        if end > n:
-            return
-        payload = raw[start:end]
-        if zlib.crc32(payload) != crc:
-            return
-        yield payload
-        off = end
-
-
-def scan_records(path: str, missing_ok: bool = True
-                 ) -> Tuple[List[dict], int]:
-    """All whole records of a segment plus the byte offset of the first
-    torn/garbage byte (== file size when the tail is clean). Missing
-    files read as empty (or raise with ``missing_ok=False`` — the
-    reader's stale-listing retry needs the distinction)."""
-    try:
-        with open(path, "rb") as f:
-            raw = f.read()
-    except OSError:
-        if not missing_ok:
-            raise
-        return [], 0
-    records, clean = [], 0
-    for payload in iter_record_payloads(raw):
-        try:
-            records.append(json.loads(payload))
-        except ValueError:
-            break
-        clean += _HEADER.size + len(payload)
-    return records, clean
 
 
 def _segment_id(name: str) -> str:
@@ -333,32 +290,14 @@ class TSDB:
         """THE rewrite path: encode ``records`` (or write ``raw`` bytes
         — the WRITER claim) into a temp file and ``os.replace`` it over
         ``final_name`` — a reader (or a crash) sees the whole new file
-        or none of it."""
-        final = os.path.join(self.dir, final_name)
-        tmp = f"{final}.tmp-{os.getpid()}"
-        try:
-            with open(tmp, "wb") as f:
-                if raw is not None:
-                    f.write(raw)
-                else:
-                    for i, doc in enumerate(records):
-                        payload = json.dumps(doc, separators=(",", ":"),
-                                             sort_keys=True).encode()
-                        f.write(pack_record(payload))
-                        if i == 0:
-                            # "mid-compaction": meta written, samples not
-                            maybe_kill("tsdb:compact:mid")
-            if raw is None:
-                maybe_kill("tsdb:roll:pre-commit")
-                maybe_kill("tsdb:compact:pre-commit")
-            os.replace(tmp, final)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-        return final
+        or none of it. Rides the shared substrate's committed rewrite
+        with the tsdb kill points threaded through ("mid-compaction" =
+        meta record written, samples not)."""
+        return logstore.commit_file(
+            self.dir, final_name, records, raw=raw,
+            kill_mid="tsdb:compact:mid",
+            kill_pre_commit=("tsdb:roll:pre-commit",
+                             "tsdb:compact:pre-commit"))
 
     # -- active-segment lifecycle --------------------------------------------
     def _new_segment_id(self, ts_ms: int) -> str:
@@ -374,7 +313,9 @@ class TSDB:
         # _ensure_active is a registered segment writer (PIO009 table):
         # it creates the empty active file the _append_payload helper
         # owns from here on; nothing is readable until a whole
-        # checksummed record lands
+        # checksummed record lands — append-in-place is this store's
+        # discipline, not temp-write+rename
+        # pio: ignore[PIO002]: checksummed append log; torn tails truncate on recovery
         self._f = open(path, "ab")
         self._active_bytes = 0
         self._active_started_ms = ts_ms

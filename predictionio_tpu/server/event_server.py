@@ -87,7 +87,8 @@ class EventServer:
                 queue_max=ic.queue_max, flush_max=ic.flush_max,
                 linger_s=ic.linger_s, retries=ic.retries,
                 backoff_s=ic.backoff_s, backoff_cap_s=ic.backoff_cap_s,
-                flush_timeout_s=ic.flush_timeout_s, registry=self.registry)
+                flush_timeout_s=ic.flush_timeout_s,
+                partitions=ic.partitions, registry=self.registry)
         self.stats = Stats(registry=self.registry)
         from predictionio_tpu.obs.capacity import register_capacity_metrics
 

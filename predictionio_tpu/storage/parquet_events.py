@@ -32,7 +32,7 @@ import pyarrow.parquet as pq
 
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event, millis as _to_ms
-from predictionio_tpu.storage import base
+from predictionio_tpu.storage import base, logstore
 from predictionio_tpu.storage.base import StorageError, UNFILTERED, generate_id
 
 from predictionio_tpu.storage.sqlite_backend import _from_ms, _tz_offset_min
@@ -128,22 +128,12 @@ class ParquetEvents(base.EventStore):
     def _ls(self, ns: str) -> List[str]:
         """Raw namespace listing, safe against concurrent maintenance.
 
-        NOT fs.glob/fs.find: their directory walk swallows the listing
-        race (an entry unlinked between scandir and its stat makes ls
-        raise, and walk 'omits' the whole directory) and silently
-        returns [] — indistinguishable from an empty store, so a reader
-        concurrent with compaction's unlinks would see zero rows with no
-        error to retry on. fs.ls raises instead of swallowing; retry
-        until a clean pass (unlink windows are microseconds)."""
-        last: Optional[Exception] = None
-        for _ in range(_LIST_RETRIES):
-            try:
-                return list(self.client.fs.ls(ns, detail=False))
-            except FileNotFoundError as ex:
-                last = ex
-        raise StorageError(
-            f"listing {ns} kept failing under concurrent maintenance: "
-            f"{last}")
+        Rides the substrate's retrying lister (see
+        :func:`logstore.ls_retry` for why glob/find are unsafe here);
+        unlink windows are microseconds, so the retry converges."""
+        return logstore.ls_retry(self.client.fs, ns,
+                                 retries=_LIST_RETRIES,
+                                 error_cls=StorageError)
 
     def _names(self, ns: str, pattern: str,
                names: Optional[List[str]] = None) -> List[str]:
@@ -205,17 +195,11 @@ class ParquetEvents(base.EventStore):
             return ""
 
     def _bump_gen(self, ns: str) -> None:
-        tmp = f"{ns}/tmp-{uuid.uuid4().hex}"
-        with self.client.fs.open(tmp, "wb") as f:
-            f.write(generate_id().encode())
-        self.client.fs.mv(tmp, f"{ns}/_pio_gen")
+        logstore.fs_commit_bytes(self.client.fs, f"{ns}/_pio_gen",
+                                 generate_id().encode())
 
     def _read_manifest(self, path: str) -> Optional[dict]:
-        try:
-            with self.client.fs.open(path, "rb") as f:
-                return json.loads(f.read().decode())
-        except (OSError, ValueError):
-            return None
+        return logstore.fs_read_json(self.client.fs, path)
 
     # -- CRUD ---------------------------------------------------------------
     def insert(self, event: Event, app_id: int,
@@ -262,23 +246,11 @@ class ParquetEvents(base.EventStore):
         return path
 
     def _write_parquet(self, path: str, table: pa.Table) -> None:
-        # temp-write + rename (the FSModels.insert pattern): a crash mid-
-        # write leaves only a tmp-* file no glob matches, never a torn
-        # fragment visible to _fragments(); the tmp stays in the same
-        # directory so the final mv is a metadata move, not a copy
-        ns = path.rsplit("/", 1)[0]
-        tmp = f"{ns}/tmp-{uuid.uuid4().hex}"
-        try:
-            with self.client.fs.open(tmp, "wb") as f:
-                pq.write_table(table, f)
-            self.client.fs.mv(tmp, path)
-        except BaseException:
-            try:
-                if self.client.fs.exists(tmp):
-                    self.client.fs.rm(tmp)
-            except Exception:
-                pass
-            raise
+        # staged-write + rename (the FSModels.insert pattern) via the
+        # substrate: a crash mid-write leaves only a tmp-* file no glob
+        # matches, never a torn fragment visible to _fragments()
+        with logstore.fs_commit_stream(self.client.fs, path) as f:
+            pq.write_table(table, f)
 
     def insert_batch_idempotent(self, events: Sequence[Event], app_id: int,
                                 channel_id: Optional[int] = None
@@ -393,10 +365,8 @@ class ParquetEvents(base.EventStore):
         faults.maybe_kill("compact:pending-written")
         manifest = {"old": frags, "tombs": tomb_files, "pending": pending,
                     "final": f"{ns}/part-{cid}.parquet" if pending else None}
-        mtmp = f"{ns}/tmp-{uuid.uuid4().hex}"
-        with self.client.fs.open(mtmp, "wb") as f:
-            f.write(json.dumps(manifest).encode())
-        self.client.fs.mv(mtmp, f"{ns}/compact-{cid}.json")  # COMMIT
+        logstore.fs_commit_bytes(self.client.fs, f"{ns}/compact-{cid}.json",
+                                 json.dumps(manifest).encode())   # COMMIT
         faults.maybe_kill("compact:committed")
         self._finish(ns, f"{ns}/compact-{cid}.json", manifest)
         stats["removed_rows"] = rows_before - t.num_rows

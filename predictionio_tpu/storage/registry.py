@@ -24,6 +24,7 @@ and embedded use; `Storage.reset` clears the cache.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import threading
@@ -31,6 +32,8 @@ from typing import Dict, Optional
 
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.base import StorageError
+
+log = logging.getLogger("pio.storage")
 
 _SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_([A-Z0-9_]+)$")
 _REPO_RE = re.compile(r"^PIO_STORAGE_REPOSITORIES_([^_]+)_(NAME|SOURCE)$")
@@ -197,6 +200,7 @@ class Storage:
         client = cls._client(source_name)
         obj = _construct(stype, kind, client, source)
         if kind == "events":
+            obj = _maybe_partition(stype, client, obj)
             from predictionio_tpu.storage import faults
 
             if faults.env_enabled():
@@ -256,6 +260,40 @@ class Storage:
         events.init_channel(0, None)
         events.remove_channel(0, None)
         return True
+
+
+def _ingest_partitions() -> int:
+    """Requested partition count. Read from the env here (registered in
+    analysis/registry.KNOB_OWNERS) rather than through ServerConfig:
+    the storage layer must agree on layout with offline CLI tools
+    (train, export, reshard) that never load a server config. The
+    committed partition map on disk is authoritative either way — see
+    storage/partitioned.maybe_partitioned."""
+    try:
+        return int(os.environ.get("PIO_INGEST_PARTITIONS", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _maybe_partition(stype: str, client, obj):
+    """Wrap a freshly built event store in the partitioned router when
+    partitioning is requested or already committed on disk."""
+    requested = _ingest_partitions()
+    if stype in ("sqlite", "parquet"):
+        from predictionio_tpu.storage.partitioned import (
+            ParquetPartitions, SqlitePartitions, maybe_partitioned)
+
+        if stype == "sqlite":
+            return maybe_partitioned(
+                obj, lambda: SqlitePartitions(client.path), requested)
+        return maybe_partitioned(
+            obj, lambda: ParquetPartitions(client), requested)
+    if requested > 1:
+        log.warning(
+            "PIO_INGEST_PARTITIONS=%d requested but the %r event store "
+            "does not support partitioning; running unpartitioned",
+            requested, stype)
+    return obj
 
 
 def _construct(stype: str, kind: str, client, source_conf: Dict[str, str]):

@@ -115,6 +115,13 @@ class IngestConfig:
 
     ``max_events_per_batch`` is the ``/batch/events.json`` request cap
     (EventServer.scala:66's constant 50, now tunable for bulk loaders).
+
+    ``partitions`` runs the buffer as that many parallel commit lanes
+    (one per event-store partition, routed by entity hash — see
+    storage/partitioned.py). Set ``PIO_INGEST_PARTITIONS`` identically
+    for the server AND the offline CLI so the store layout agrees; the
+    committed partition map on disk stays authoritative for the store,
+    and changing an existing store's count takes ``pio reshard``.
     """
 
     max_events_per_batch: int = 50
@@ -126,6 +133,7 @@ class IngestConfig:
     backoff_s: float = 0.05
     backoff_cap_s: float = 1.0
     flush_timeout_s: float = 30.0
+    partitions: int = 1
 
     @classmethod
     def from_env(cls, data: Optional[dict] = None) -> "IngestConfig":
@@ -148,6 +156,7 @@ class IngestConfig:
             ("backoffCapS", data.get("backoffCapS"), "backoff_cap_s", float),
             ("flushTimeoutS", data.get("flushTimeoutS"),
              "flush_timeout_s", float),
+            ("partitions", data.get("partitions"), "partitions", int),
             ("PIO_MAX_EVENTS_PER_BATCH",
              os.environ.get("PIO_MAX_EVENTS_PER_BATCH"),
              "max_events_per_batch", int),
@@ -169,6 +178,9 @@ class IngestConfig:
             ("PIO_INGEST_FLUSH_TIMEOUT_S",
              os.environ.get("PIO_INGEST_FLUSH_TIMEOUT_S"),
              "flush_timeout_s", float),
+            ("PIO_INGEST_PARTITIONS",
+             os.environ.get("PIO_INGEST_PARTITIONS"),
+             "partitions", int),
         )
         for name, raw, attr, conv in sources:
             if raw is None or raw == "":

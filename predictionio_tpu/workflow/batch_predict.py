@@ -41,7 +41,12 @@ at maximal batch sizes behind the same shape discipline serving uses.
     (O_EXCL) and concatenates fragments in rank order into the final
     path — so the merged output is identical to a single-process run,
     and a kill at ANY point leaves nothing partial visible at the
-    final path.
+    final path. Query rows shard here; each shard's *event* reads go
+    through ``training_scan``'s shard/snapshot protocol, which a
+    partitioned event store (``PIO_INGEST_PARTITIONS``,
+    storage/partitioned.py) maps onto its partitions — whole
+    partitions per shard when shards <= partitions, sub-sharded
+    within one partition when shards exceed them.
 
 Malformed input rows (unparseable JSON, queries that don't fit the
 engine's query class, rows an engine fails on) never abort the run:

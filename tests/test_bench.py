@@ -205,12 +205,22 @@ def test_bench_ingest_write_smoke(tmp_path):
     detail must carry the events/s + p99 + flush-size fields the judged
     run records for both backends. The judged-scale speedup floor is 5x
     (the tentpole bar); the smoke floor is relaxed — small batches on a
-    busy 2-core CI box measure mostly scheduler noise."""
+    busy 2-core CI box measure mostly scheduler noise. PR 17: the detail
+    must also carry the 1/2/4-partition scaling curve (commit-wall
+    regime); the judged floor is 2.5x at 4 partitions, the smoke floor
+    is relaxed for the same reason."""
     p = _run("ingest_write", "300", timeout=280, tmp_path=tmp_path,
+             # the speedup floor is 1.25, not 1.5: on a fast-fsync box
+             # (tmpfs/ext4 with write cache) the per-request denominator
+             # is cheap and the true smoke-scale ratio sits near 1.5, so
+             # a 1.5 floor is a coin flip on measurement noise. The
+             # coalescing contract is separately pinned by mean_flush.
              extra_env={"BENCH_INGEST_WRITE_EVENTS": "3072",
                         "BENCH_INGEST_WRITE_CLIENTS": "8",
-                        "BENCH_INGEST_WRITE_MIN_SPEEDUP": "1.5",
-                        "BENCH_INGEST_WRITE_P99_MS": "5000"})
+                        "BENCH_INGEST_WRITE_MIN_SPEEDUP": "1.25",
+                        "BENCH_INGEST_WRITE_P99_MS": "5000",
+                        "BENCH_INGEST_SCALING_EVENTS": "2048",
+                        "BENCH_INGEST_WRITE_MIN_SCALING": "1.3"})
     assert p.returncode == 0, p.stderr[-2000:]
     lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
@@ -228,8 +238,18 @@ def test_bench_ingest_write_smoke(tmp_path):
             assert key in detail, (key, detail)
         # group commit must actually coalesce and actually win
         assert detail[f"mean_flush_{backend}"] > 1.0
-        assert detail[f"speedup_{backend}"] >= 1.5
-    assert detail["speedup_headline"] >= 1.5
+        assert detail[f"speedup_{backend}"] >= 1.25
+    assert detail["speedup_headline"] >= 1.25
+    # PR 17: the partition scaling curve is persisted with every run,
+    # with the injected commit wall disclosed alongside the numbers
+    for parts in (1, 2, 4):
+        assert detail[f"partition_events_per_s_{parts}"] > 0, detail
+    for key in ("partition_scaling_2x", "partition_scaling_4x",
+                "commit_floor_ms", "commit_floor_injected",
+                "scaling_headline"):
+        assert key in detail, (key, detail)
+    assert detail["commit_floor_injected"] is True
+    assert detail["scaling_headline"] >= 1.3
 
 
 def test_bench_telemetry_smoke(tmp_path):

@@ -1,0 +1,435 @@
+"""Partitioned event store chaos + parity suite (PR 17, ROADMAP item 3).
+
+Proves the ISSUE 17 acceptance bar at test scale: the PR 6 chaos
+guarantees (zero loss, zero duplication, convergent recovery) hold
+per-partition AND across a resharding event killed at any point, the
+shard protocol maps reader shards onto partitions disjointly and
+completely, and `training_scan` over a partitioned store is
+row-for-row identical to the unpartitioned scan for every engine's
+scan shape.
+"""
+
+import datetime as dt
+import random
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, UTC
+from predictionio_tpu.data.write_buffer import BufferFull, WriteBuffer
+from predictionio_tpu.obs.registry import MetricsRegistry
+from predictionio_tpu.storage import faults
+from predictionio_tpu.storage.base import StorageError
+from predictionio_tpu.storage.faults import CrashError, FaultyEvents
+from predictionio_tpu.storage.parquet_events import ParquetEventsClient
+from predictionio_tpu.storage.partitioned import (
+    ParquetPartitions, PartitionedEvents, SqlitePartitions, partition_of,
+    shard_partitions,
+)
+from predictionio_tpu.storage import App, Storage
+
+APP = 7
+
+
+def ev(i, *, name="view", entity=None):
+    return Event(
+        event=name, entity_type="user", entity_id=entity or f"u{i}",
+        target_entity_type="item", target_entity_id=f"i{i}",
+        event_time=dt.datetime(2026, 1, 1, tzinfo=UTC)
+        + dt.timedelta(seconds=i))
+
+
+def stored_ids(store):
+    return sorted(e.event_id for e in store.find(APP))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_kill_points():
+    yield
+    faults.set_kill_points([])
+
+
+def make_parts(tmp_path, backend, count):
+    if backend == "parquet":
+        layout = ParquetPartitions(
+            ParquetEventsClient(str(tmp_path / "events")))
+    else:
+        layout = SqlitePartitions(str(tmp_path / "ev.db"))
+    store = PartitionedEvents(layout, initial_count=count)
+    store.init_channel(APP)
+    return store
+
+
+def reopen_parts(tmp_path, backend):
+    """Fresh layout + store on the same path — a process restart."""
+    if backend == "parquet":
+        layout = ParquetPartitions(
+            ParquetEventsClient(str(tmp_path / "events")))
+    else:
+        layout = SqlitePartitions(str(tmp_path / "ev.db"))
+    return PartitionedEvents(layout)
+
+
+# ---------------------------------------------------------------------------
+# shard protocol: disjoint + complete over every (shards, partitions) shape
+# ---------------------------------------------------------------------------
+
+def test_shard_partitions_disjoint_and_complete():
+    for partitions in (1, 2, 3, 4, 8):
+        for shards in (1, 2, 3, 4, 5, 16):
+            whole, subs = set(), {}
+            for s in range(shards):
+                for p, sub in shard_partitions(s, shards, partitions):
+                    if sub is None:
+                        assert p not in whole, (shards, partitions, p)
+                        whole.add(p)
+                    else:
+                        subs.setdefault(p, []).append(sub)
+            assert whole.isdisjoint(subs)
+            assert whole | set(subs) == set(range(partitions))
+            for p, pieces in subs.items():
+                k_p = pieces[0][1]
+                assert sorted(pieces) == [(j, k_p) for j in range(k_p)], \
+                    (shards, partitions, p, pieces)
+
+
+def test_partition_of_is_stable_and_entity_local():
+    # crc32 routing, NOT salted hash(): the same key must route the same
+    # way in every process — a restart's reads find its writes
+    assert partition_of(7, None, "u1", 4) == partition_of(7, None, "u1", 4)
+    assert partition_of(7, None, None, 4) == partition_of(7, 0, "", 4)
+    assert 0 <= partition_of(7, 3, "u9", 4) < 4
+
+
+# ---------------------------------------------------------------------------
+# exactly-once through the partition split (both backends)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["sqlite", "parquet"])
+def test_roundtrip_exactly_once_and_idempotent(tmp_path, backend):
+    store = make_parts(tmp_path, backend, 4)
+    events = [ev(i) for i in range(120)]
+    ids = store.insert_batch(events, APP)
+    assert len(set(ids)) == 120
+    # the idempotent path (the retrying flush + the reshard stage) must
+    # converge, not duplicate, when replayed with the same event ids
+    store.insert_batch_idempotent(
+        [e for e in store.find(APP)], APP)
+    assert stored_ids(store) == sorted(ids)
+    # rows actually spread over the partitions (crc32 on 120 entities)
+    occupied = [k for k in range(4)
+                if list(store.partition_store(k).find(APP))]
+    assert len(occupied) >= 2
+    store.close()
+
+
+@pytest.mark.parametrize("backend", ["sqlite", "parquet"])
+def test_sharded_reads_union_to_full_scan(tmp_path, backend):
+    store = make_parts(tmp_path, backend, 3)
+    store.insert_batch([ev(i) for i in range(90)], APP)
+    full = stored_ids(store)
+    snap = store.read_snapshot(APP)
+    for shards in (1, 2, 5):
+        got = []
+        for s in range(shards):
+            t = store.find_columnar(APP, shard=(s, shards, snap))
+            got.extend(t.column("event_id").to_pylist())
+        assert sorted(got) == full, f"shards={shards}"
+    store.close()
+
+
+def test_stale_snapshot_refused_after_reshard(tmp_path):
+    store = make_parts(tmp_path, "sqlite", 2)
+    store.insert_batch([ev(i) for i in range(20)], APP)
+    snap = store.read_snapshot(APP)
+    store.reshard(3, [(APP, None)])
+    with pytest.raises(StorageError, match="partition count changed"):
+        store.find_columnar(APP, shard=(0, 2, snap))
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# commit lanes: chaos through the write buffer, per-lane shedding
+# ---------------------------------------------------------------------------
+
+def test_lanes_retry_faults_no_loss_no_dup(tmp_path):
+    store = make_parts(tmp_path, "sqlite", 4)
+    faulty = FaultyEvents(store, fail_n=3, when="before")
+    reg = MetricsRegistry()
+    buf = WriteBuffer(store_fn=lambda: faulty, partitions=4, retries=5,
+                      backoff_s=0.001, backoff_cap_s=0.002,
+                      linger_s=0.01, registry=reg)
+    # mixed shapes: single events AND submits spanning several lanes
+    futures = [buf.submit([ev(i)], APP) for i in range(60)]
+    futures += [buf.submit([ev(100 + j * 10 + k) for k in range(10)], APP)
+                for j in range(9)]
+    ids = [i for f in futures for i in f.result(timeout=30)]
+    buf.stop()
+    assert faulty.faults_fired == 3
+    assert len(set(ids)) == 150
+    assert stored_ids(store) == sorted(ids)
+    # the per-partition metric series exist with the partition label
+    flush = reg.get("pio_ingest_partition_flush_size")
+    assert flush.total_count() > 0
+    assert sum(flush.count(partition=str(k)) for k in range(4)) \
+        == flush.total_count()
+    assert reg.get("pio_ingest_partition_commit_seconds").total_count() > 0
+    store.close()
+
+
+def test_buffer_full_sheds_per_lane_not_globally(tmp_path):
+    """A wedged partition sheds ITS lane with a lane-derived Retry-After
+    while the other lanes keep accepting (satellite: the 429 hint must
+    reflect the lane the caller actually hashed onto)."""
+    store = make_parts(tmp_path, "sqlite", 2)
+    lane_of = lambda e: partition_of(APP, None, e, 2)  # noqa: E731
+    lane0 = next(f"u{i}" for i in range(100) if lane_of(f"u{i}") == 0)
+    lane1 = next(f"u{i}" for i in range(100) if lane_of(f"u{i}") == 1)
+
+    class Wedged:
+        def insert_batch(self, events, app_id, channel_id=None):
+            if partition_of(app_id, channel_id, events[0].entity_id,
+                            2) == 0:
+                assert gate.wait(10), "gate never released"
+            return store.insert_batch(events, app_id, channel_id)
+
+        def __getattr__(self, name):
+            return getattr(store, name)
+
+    gate = threading.Event()
+    buf = WriteBuffer(store_fn=Wedged, partitions=2, queue_max=8,
+                      linger_s=0.0, flush_max=4)
+    # lane 0 is wedged mid-flush: keep submitting single events until
+    # its 4-slot lane queue sheds (well under 20 submits)
+    held = []
+    with pytest.raises(BufferFull) as exc:
+        for i in range(20):
+            held.append(buf.submit([ev(i, entity=lane0)], APP))
+            time.sleep(0.002)
+    assert exc.value.retry_after > 0
+    # the OTHER lane is unaffected: accepts and commits immediately
+    ok = buf.submit([ev(50, entity=lane1)], APP)
+    assert ok.result(timeout=10)
+    gate.set()
+    for f in held:
+        f.result(timeout=20)
+    buf.stop()
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# kill-point chaos: per-partition compaction and mid-reshard
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kill_point", [
+    "compact:pending-written", "compact:committed", "compact:renamed",
+    "compact:old-removed", "compact:gen-bumped",
+])
+def test_kill_mid_partition_compaction_no_loss_no_dup(tmp_path, kill_point):
+    """PR 6's kill-anywhere compaction guarantee, now per partition: the
+    crash lands inside ONE partition's compactor; every partition still
+    serves exactly the live rows and the next compact converges."""
+    store = make_parts(tmp_path, "parquet", 3)
+    for i in range(30):                      # one fragment per insert
+        store.insert(ev(i), APP)
+    live = stored_ids(store)
+    faults.set_kill_points([kill_point])
+    with pytest.raises(CrashError):
+        store.compact(APP)
+    assert stored_ids(store) == live
+    stats = store.compact(APP)
+    assert stored_ids(store) == live
+    assert 1 <= stats["fragments_after"] <= store.partition_count
+    store.close()
+
+
+@pytest.mark.parametrize("backend", ["sqlite", "parquet"])
+@pytest.mark.parametrize("kill_point", [
+    "reshard:staged", "reshard:committed", "reshard:old-removed",
+])
+def test_kill_mid_reshard_exactly_once(tmp_path, backend, kill_point):
+    """Kill the reshard at every point; a restart (fresh layout + store
+    on the same path) must serve exactly one copy of every event, and
+    re-running the reshard must converge to the new count."""
+    store = make_parts(tmp_path, backend, 2)
+    ids = store.insert_batch([ev(i) for i in range(80)], APP)
+    faults.set_kill_points([kill_point])
+    with pytest.raises(CrashError):
+        store.reshard(4, [(APP, None)])
+    faults.set_kill_points([])
+
+    survivor = reopen_parts(tmp_path, backend)
+    # exactly-once at the kill point: the committed map decides which
+    # generation is real, and that generation holds every event once
+    assert stored_ids(survivor) == sorted(ids)
+    expected = 2 if kill_point == "reshard:staged" else 4
+    assert survivor.partition_count == expected
+    # the operator re-runs the op (it is safe to re-run); either it
+    # rolls forward from the old count or it is already done
+    stats = survivor.reshard(4, [(APP, None)])
+    assert survivor.partition_count == 4
+    assert stored_ids(survivor) == sorted(ids)
+    if kill_point == "reshard:staged":
+        assert stats["copied"] == 80
+    # no stray generations left on disk
+    assert {g for g, _ in survivor.layout.parts()} \
+        == {survivor.generation}
+    survivor.close()
+
+
+@pytest.mark.parametrize("backend", ["sqlite", "parquet"])
+def test_reshard_down_preserves_rows(tmp_path, backend):
+    store = make_parts(tmp_path, backend, 4)
+    ids = store.insert_batch([ev(i) for i in range(60)], APP)
+    stats = store.reshard(2, [(APP, None)])
+    assert stats["copied"] == 60 and store.partition_count == 2
+    assert stored_ids(store) == sorted(ids)
+    # reads route correctly post-reshard: entity filter finds its rows
+    some = next(iter(store.find(APP)))
+    got = list(store.find(APP, entity_id=some.entity_id,
+                          entity_type="user"))
+    assert any(e.event_id == some.event_id for e in got)
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# training_scan parity: partitioned == unpartitioned for every engine shape
+# ---------------------------------------------------------------------------
+
+#: each engine's exact training_scan shape (engines/*.py); classification
+#: uses aggregate_scan and is covered separately below
+ENGINE_SCANS = {
+    "ecommerce": dict(
+        entity_type="user", event_names=["view", "buy"],
+        target_entity_type="item",
+        columns=("event", "entity_id", "target_entity_id")),
+    "recommendation": dict(
+        sharded=True, entity_type="user", event_names=["rate", "buy"],
+        target_entity_type="item", ordered=False,
+        columns=("event", "entity_id", "target_entity_id", "properties")),
+    "recommended_user": dict(
+        entity_type="user", event_names=["follow"],
+        target_entity_type="user",
+        columns=("entity_id", "target_entity_id", "event_time_ms")),
+    "sessionrec": dict(
+        entity_type="user", event_names=["view", "buy"],
+        target_entity_type="item",
+        columns=("entity_id", "target_entity_id", "event_time_ms")),
+    "similarproduct": dict(
+        entity_type="user", event_names=["view", "like", "dislike"],
+        target_entity_type="item",
+        columns=("event", "entity_id", "target_entity_id",
+                 "event_time_ms")),
+}
+
+
+def _seed_engine_events(backend, name):
+    app_id = backend.get_meta_data_apps().insert(App(id=0, name=name))
+    store = backend.get_events()
+    store.init_channel(app_id)
+    rng = random.Random(23)
+    t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    events = []
+    for u in range(8):
+        events.append(Event(event="$set", entity_type="user",
+                            entity_id=f"u{u}",
+                            event_time=t0 + dt.timedelta(seconds=u)))
+    for k in range(100):
+        kind = rng.choice(["view", "buy", "like", "dislike", "rate",
+                           "follow"])
+        u = rng.randrange(8)
+        t = t0 + dt.timedelta(seconds=100 + k)
+        if kind == "follow":
+            events.append(Event(
+                event="follow", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="user",
+                target_entity_id=f"u{rng.randrange(8)}", event_time=t))
+        else:
+            props = (DataMap({"rating": float(rng.randrange(1, 6))})
+                     if kind == "rate" else DataMap())
+            events.append(Event(
+                event=kind, entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.randrange(6)}",
+                properties=props, event_time=t))
+    store.insert_batch(events, app_id)
+    return app_id
+
+
+def _scan_rows(tmp_path, partitions, shape, monkeypatch, tag):
+    """Configure a fresh sqlite source (optionally partitioned), seed the
+    deterministic engine workload, run the engine's exact scan shape."""
+    from predictionio_tpu.data.eventstore import clear_cache
+    from predictionio_tpu.data.ingest import clear_scan_cache, training_scan
+
+    if partitions > 1:
+        monkeypatch.setenv("PIO_INGEST_PARTITIONS", str(partitions))
+    else:
+        monkeypatch.delenv("PIO_INGEST_PARTITIONS", raising=False)
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite",
+                           "PATH": str(tmp_path / f"{tag}.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    clear_cache()
+    clear_scan_cache()
+    try:
+        _seed_engine_events(Storage, "ParityApp")
+        table = training_scan("ParityApp", cache=False, **shape).table
+        return sorted(repr(row) for row in table.to_pylist())
+    finally:
+        Storage.reset()
+        clear_cache()
+        clear_scan_cache()
+
+
+@pytest.mark.parametrize("engine", sorted(ENGINE_SCANS))
+def test_training_scan_parity_partitioned_vs_not(tmp_path, monkeypatch,
+                                                 engine):
+    shape = ENGINE_SCANS[engine]
+    flat = _scan_rows(tmp_path, 1, shape, monkeypatch, f"{engine}_flat")
+    parts = _scan_rows(tmp_path, 4, shape, monkeypatch, f"{engine}_part")
+    assert flat == parts
+    assert len(flat) > 0
+
+
+def test_aggregate_scan_parity_classification(tmp_path, monkeypatch):
+    """classification's data path is aggregate_scan($set fold), which
+    rides find_columnar's ordered merge — partition-order must not leak
+    into the folded properties."""
+    from predictionio_tpu.data.eventstore import clear_cache
+    from predictionio_tpu.data.ingest import aggregate_scan, clear_scan_cache
+
+    results = []
+    for partitions, tag in ((1, "cls_flat"), (4, "cls_part")):
+        if partitions > 1:
+            monkeypatch.setenv("PIO_INGEST_PARTITIONS", str(partitions))
+        else:
+            monkeypatch.delenv("PIO_INGEST_PARTITIONS", raising=False)
+        Storage.configure({
+            "sources": {"DB": {"TYPE": "sqlite",
+                               "PATH": str(tmp_path / f"{tag}.db")}},
+            "repositories": {
+                "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+                "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+                "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+            },
+        })
+        clear_cache()
+        clear_scan_cache()
+        try:
+            _seed_engine_events(Storage, "ClsApp")
+            props = aggregate_scan("ClsApp", "user")
+            results.append({k: dict(v) for k, v in props.items()})
+        finally:
+            Storage.reset()
+            clear_cache()
+            clear_scan_cache()
+    assert results[0] == results[1]
+    assert len(results[0]) > 0

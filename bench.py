@@ -2808,6 +2808,208 @@ def cfg_topk_scoring(jax, mesh, platform):
     return detail
 
 
+def _fleet_shape():
+    """Judged defaults vs BENCH_FLEET_* smoke overrides (one code
+    path). The replica service time is INJECTED (each stub replica
+    models `slots` serving lanes of `service_ms` each with a semaphore
+    + sleep) — the leg judges the ROUTER tier's scaling, not a model's
+    kernel time, and the injection is disclosed in the detail."""
+    service_ms = float(os.environ.get("BENCH_FLEET_SERVICE_MS", 20.0))
+    slots = int(os.environ.get("BENCH_FLEET_SLOTS", 1))
+    clients_per = int(os.environ.get("BENCH_FLEET_CLIENTS_PER_REPLICA", 3))
+    stage_s = float(os.environ.get("BENCH_FLEET_STAGE_S", 4.0))
+    min_scaling = float(os.environ.get("BENCH_FLEET_MIN_SCALING", 3.0))
+    p99_ratio = float(os.environ.get("BENCH_FLEET_P99_RATIO", 2.0))
+    items = int(os.environ.get("BENCH_FLEET_ITEMS", 200_000))
+    rank = int(os.environ.get("BENCH_FLEET_RANK", 64))
+    shards = int(os.environ.get("BENCH_FLEET_SHARDS", 4))
+    return service_ms, slots, clients_per, stage_s, min_scaling, \
+        p99_ratio, items, rank, shards
+
+
+def cfg_fleet_scaling(jax, mesh, platform):
+    """The serving-fleet tentpole, CPU-judged: (1) QPS through the REAL
+    router tier (server/router.py — error-diffusion spread, health
+    probes, retry-on-other-replica) scales near-linearly 1 -> 2 -> 4
+    replicas at flat p99, with offered load scaled per replica (the
+    standard open-loop scaling method) and zero dropped queries; (2) a
+    sharded catalog (ops/scoring.ShardedScorer) serves item factors
+    LARGER than one device's simulated HBM budget with exact top-k
+    parity to the unsharded scorer.
+
+    Asserts: qps(4)/qps(1) >= BENCH_FLEET_MIN_SCALING (3x CPU floor),
+    p99(4) <= p99(1) x BENCH_FLEET_P99_RATIO, dropped == 0 at every
+    stage, max per-shard factor bytes <= budget < whole-catalog bytes,
+    and sharded ids == unsharded ids exactly."""
+    import asyncio
+
+    from predictionio_tpu.ops.scoring import build_sharded_scorer
+    from predictionio_tpu.ops.topk import host_topk
+    from predictionio_tpu.utils.server_config import (
+        RouterConfig, ScorerConfig,
+    )
+
+    service_ms, slots, clients_per, stage_s, min_scaling, p99_ratio, \
+        items, rank, shards = _fleet_shape()
+    t_start = time.perf_counter()
+    detail = {"service_ms_injected": service_ms,
+              "slots_per_replica": slots,
+              "clients_per_replica": clients_per}
+
+    # -- leg 1: router QPS scaling over stub replicas ------------------------
+    async def start_replica():
+        from aiohttp import web
+
+        sem = asyncio.Semaphore(slots)
+
+        async def queries(request):
+            await request.read()
+            async with sem:         # `slots` concurrent serving lanes
+                await asyncio.sleep(service_ms / 1000.0)
+            return web.json_response({"itemScores": []})
+
+        async def slo(request):
+            return web.json_response({"breached": False})
+
+        async def status(request):
+            return web.json_response({"active": {"releaseVersion": 1}})
+
+        app = web.Application()
+        app.router.add_post("/queries.json", queries)
+        app.router.add_get("/slo.json", slo)
+        app.router.add_get("/deploy/status.json", status)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        return runner, f"http://127.0.0.1:{port}"
+
+    async def run_stage(n_replicas):
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from predictionio_tpu.server.router import Router
+
+        runners, urls = [], []
+        for _ in range(n_replicas):
+            runner, url = await start_replica()
+            runners.append(runner)
+            urls.append(url)
+        router = Router(
+            RouterConfig(health_interval_s=0.2, health_fail_after=2,
+                         proxy_retries=1),
+            replica_urls=urls)
+        client = TestClient(TestServer(router.app))
+        await client.start_server()
+        for rank_ in list(router.replicas):
+            assert await router.wait_replica_healthy(rank_, timeout_s=10)
+        latencies = []
+        done = 0
+        deadline = time.perf_counter() + stage_s
+
+        async def one_client():
+            nonlocal done
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                async with client.post(
+                        "/queries.json", json={"user": "u1"}) as resp:
+                    await resp.read()
+                    assert resp.status == 200, resp.status
+                latencies.append(time.perf_counter() - t0)
+                done += 1
+
+        clients = [one_client()
+                   for _ in range(clients_per * n_replicas)]
+        t0 = time.perf_counter()
+        await asyncio.gather(*clients)
+        elapsed = time.perf_counter() - t0
+        dropped = sum(v for _, v in router._dropped.samples())
+        spread = {rank_: router._requests.value(replica=str(rank_),
+                                                status="200")
+                  for rank_ in router.replicas}
+        await client.close()
+        for runner in runners:
+            await runner.cleanup()
+        qps = done / elapsed
+        p99 = float(np.percentile(latencies, 99)) * 1000.0
+        return qps, p99, dropped, spread
+
+    qps_by_n = {}
+    for n in (1, 2, 4):
+        hb(f"fleet_scaling router stage n={n}")
+        qps, p99, dropped, spread = asyncio.run(run_stage(n))
+        qps_by_n[n] = qps
+        detail[f"qps_{n}"] = round(qps, 1)
+        detail[f"p99_ms_{n}"] = round(p99, 2)
+        assert dropped == 0, (
+            f"{dropped} dropped queries at {n} replicas — the router "
+            "must never fail a query while every replica is healthy")
+        # the error-diffusion spread must be exact (±1 per replica)
+        total = sum(spread.values())
+        for rank_, served in spread.items():
+            assert abs(served - total / n) <= 1.0, (
+                f"replica {rank_} served {served}/{total} at {n} "
+                "replicas — splitter spread is not exact")
+    scaling = qps_by_n[4] / max(1e-9, qps_by_n[1])
+    detail["scaling_4"] = round(scaling, 2)
+    assert scaling >= min_scaling, (
+        f"4-replica scaling {scaling:.2f}x under the {min_scaling}x "
+        f"floor (qps {qps_by_n[1]:.0f} -> {qps_by_n[4]:.0f})")
+    assert detail["p99_ms_4"] <= detail["p99_ms_1"] * p99_ratio + 5.0, (
+        f"p99 not flat under scaling: {detail['p99_ms_1']}ms at 1 "
+        f"replica vs {detail['p99_ms_4']}ms at 4 (bound "
+        f"{p99_ratio}x + 5ms)")
+
+    # -- leg 2: sharded catalog beyond one device's budget -------------------
+    hb("fleet_scaling sharded-catalog build")
+    rng = np.random.default_rng(13)
+    spec = np.power(10.0, -1.5 * np.arange(rank) / max(1, rank - 1))
+    V = (rng.standard_normal((items, rank)) * spec).astype(np.float32)
+    U = (rng.standard_normal((16, rank)) * spec).astype(np.float32)
+    # the simulated device budget: HALF the catalog — an unsharded
+    # residency cannot fit, each of the `shards` shards trivially does
+    budget = V.nbytes // 2
+    scorer = build_sharded_scorer(
+        V, ScorerConfig(mode="fused", tile_items=16384, shards=shards),
+        shards=shards)
+    status = scorer.status()
+    detail["sharded_items"] = items
+    detail["sharded_shards"] = shards
+    detail["catalog_bytes"] = int(status["exactBytes"])
+    detail["device_budget_bytes"] = int(budget)
+    detail["max_shard_factor_bytes"] = int(status["maxShardFactorBytes"])
+    assert status["maxShardFactorBytes"] <= budget < status["exactBytes"], (
+        f"sharded residency {status['maxShardFactorBytes']}B must fit "
+        f"the {budget}B budget the {status['exactBytes']}B catalog "
+        "exceeds")
+    hb("fleet_scaling sharded parity")
+    ref_v, ref_i = host_topk(U @ V.T, 10)
+    out_v, out_i = scorer.topk(U, 10)
+    assert np.array_equal(np.asarray(out_i), ref_i), (
+        "sharded top-k ids diverge from the unsharded scorer")
+    assert np.allclose(np.asarray(out_v), ref_v, rtol=1e-5, atol=1e-5), (
+        "sharded top-k scores diverge from the unsharded scorer")
+    detail["sharded_parity"] = 1.0
+
+    detail.update({
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+        "baseline_s": None,
+        "speedup_headline": detail["scaling_4"],
+        "service_floor_injected": True,
+        "note": (f"router QPS {detail['qps_1']} -> {detail['qps_2']} -> "
+                 f"{detail['qps_4']} over 1/2/4 replicas "
+                 f"({scaling:.2f}x, floor {min_scaling}x) at p99 "
+                 f"{detail['p99_ms_1']} -> {detail['p99_ms_4']}ms, zero "
+                 f"drops (replica service {service_ms}ms x {slots} "
+                 f"lanes INJECTED, load scaled per replica); sharded "
+                 f"catalog {status['exactBytes'] >> 20}MB over "
+                 f"{shards} shards fits a {budget >> 20}MB device "
+                 f"budget with exact parity"),
+    })
+    return detail
+
+
 def cfg_sleep_forever(jax, mesh, platform):
     """Test-only config (never in the default set): wedges the worker so
     the orchestrator's watchdog + ladder can be exercised on CPU."""
@@ -2833,6 +3035,7 @@ CONFIGS = {
     "batch_predict": (cfg_batch_predict, 300),
     "telemetry": (cfg_telemetry, 240),
     "topk_scoring": (cfg_topk_scoring, 240),
+    "fleet_scaling": (cfg_fleet_scaling, 300),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
 

@@ -90,6 +90,27 @@ KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
     "PIO_ORCH_HISTORY_WINDOW_S": (SERVER_CONFIG_PATH,),
     "PIO_ORCH_SMOKE_QUERIES": (SERVER_CONFIG_PATH,),
     "PIO_ORCH_STATE_DIR": (SERVER_CONFIG_PATH,),
+    # serving-fleet router knob chain (env > server.json "router") —
+    # resolved by RouterConfig in server_config; registered explicitly
+    # so the router's knob surface is enumerable by rule tooling
+    "PIO_ROUTER_PORT": (SERVER_CONFIG_PATH,),
+    "PIO_ROUTER_REPLICAS": (SERVER_CONFIG_PATH,),
+    "PIO_ROUTER_BASE_PORT": (SERVER_CONFIG_PATH,),
+    "PIO_ROUTER_HEALTH_INTERVAL_S": (SERVER_CONFIG_PATH,),
+    "PIO_ROUTER_HEALTH_FAIL_AFTER": (SERVER_CONFIG_PATH,),
+    "PIO_ROUTER_PROXY_RETRIES": (SERVER_CONFIG_PATH,),
+    "PIO_ROUTER_DRAIN_TIMEOUT_S": (SERVER_CONFIG_PATH,),
+    "PIO_ROUTER_PERSIST_SPLITTER": (SERVER_CONFIG_PATH,),
+    # SLO-driven autoscaler knob chain (env > server.json "fleet") —
+    # resolved by FleetConfig in server_config
+    "PIO_FLEET_AUTOSCALE": (SERVER_CONFIG_PATH,),
+    "PIO_FLEET_MIN_REPLICAS": (SERVER_CONFIG_PATH,),
+    "PIO_FLEET_MAX_REPLICAS": (SERVER_CONFIG_PATH,),
+    "PIO_FLEET_BURN_SUSTAIN_S": (SERVER_CONFIG_PATH,),
+    "PIO_FLEET_IDLE_QPS": (SERVER_CONFIG_PATH,),
+    "PIO_FLEET_IDLE_SUSTAIN_S": (SERVER_CONFIG_PATH,),
+    "PIO_FLEET_COOLDOWN_S": (SERVER_CONFIG_PATH,),
+    "PIO_FLEET_STATE_DIR": (SERVER_CONFIG_PATH,),
 }
 
 #: knob *families* read via pattern scan (no literal name per knob) —

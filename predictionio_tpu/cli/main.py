@@ -550,6 +550,69 @@ def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
                      telemetry_config=tcfg)
 
 
+@cli.command()
+@click.option("--variant", "-v", default="engine.json")
+@click.option("--ip", default="localhost")
+@click.option("--port", default=None, type=int,
+              help="Router port (default PIO_ROUTER_PORT / server.json).")
+@click.option("--replicas", default=None, type=int,
+              help="Query-server replicas to spawn (default "
+                   "PIO_ROUTER_REPLICAS / server.json).")
+@click.option("--replica-url", "replica_urls", multiple=True,
+              help="Front an EXISTING replica instead of spawning "
+                   "(repeatable); disables the spawner.")
+@click.option("--accesskey", default=None,
+              help="Key forwarded to spawned replicas' deploy APIs.")
+def router(variant, ip, port, replicas, replica_urls, accesskey):
+    """Serve a replicated fleet behind one router (server/router.py):
+    spawn N `pio deploy` replicas via the worker-env contract (one
+    trace id spans router -> replica -> device), spread queries with
+    the error-diffusion splitter, sequence fleet cutovers, and
+    autoscale on the SLO burn signal when server.json enables it."""
+    import os
+    import subprocess
+
+    from predictionio_tpu.server.router import run_router
+    from predictionio_tpu.utils.server_config import router_config
+
+    cfg = router_config()
+    if port is not None:
+        cfg.port = port
+    if replicas is not None:
+        cfg.replicas = max(1, replicas)
+
+    spawn = None
+    if not replica_urls:
+        from predictionio_tpu.parallel.distributed import worker_env
+
+        def spawn(rank):
+            """One replica = one `pio deploy` subprocess on
+            base_port + rank, carrying the router's trace context and
+            the PIO_PROCESS_ID/PIO_NUM_PROCESSES contract."""
+            from predictionio_tpu.server.router import ReplicaHandle
+
+            port_r = cfg.base_port + rank
+            argv = [sys.executable, "-m", "predictionio_tpu.cli.main",
+                    "deploy", "--variant", variant, "--ip", ip,
+                    "--port", str(port_r)]
+            if accesskey:
+                argv += ["--accesskey", accesskey]
+            env = worker_env(rank, max(cfg.replicas, rank + 1),
+                             base=dict(os.environ))
+            proc = subprocess.Popen(argv, env=env)
+            click.echo(f"[INFO] Spawned replica {rank} (pid {proc.pid}) "
+                       f"on {ip}:{port_r}")
+            return ReplicaHandle(rank=rank,
+                                 url=f"http://{ip}:{port_r}",
+                                 proc=proc)
+
+    click.echo(f"[INFO] Router starting at {ip}:{cfg.port} over "
+               + (f"{len(replica_urls)} existing replica(s)"
+                  if replica_urls else f"{cfg.replicas} replica(s)"))
+    run_router(config=cfg, ip=ip, spawn=spawn,
+               replica_urls=replica_urls)
+
+
 def _release_of_instance(engine_id, variant_id, instance_id):
     """The release manifest registered for an instance, if any (pre-
     release-registry instances deploy fine without one)."""

@@ -90,6 +90,26 @@ class TrafficSplitter:
             return True
         return False
 
+    def state(self) -> float:
+        """The diffusion accumulator, for persistence: process-local on
+        its own, so a restart mid-stream would re-seed at 0 and skew the
+        realized fraction for the first ~1/fraction queries. Callers
+        (the router) publish this through the telemetry store and feed
+        it back via :meth:`restore` after a restart."""
+        return self._acc
+
+    def restore(self, acc) -> None:
+        """Re-seed the accumulator from a persisted :meth:`state` value;
+        junk (None, NaN, out-of-range) is ignored rather than trusted —
+        a corrupt snapshot must not be worse than the cold start it
+        replaces."""
+        try:
+            acc = float(acc)
+        except (TypeError, ValueError):
+            return
+        if 0.0 <= acc < 1.0:
+            self._acc = acc
+
 
 class CanaryController:
     """The SLO judge for one candidate release.

@@ -220,6 +220,7 @@ class DeployMetrics:
     promote_total: Any    # pio_deploy_promote_total{reason}
     requests_total: Any   # pio_deploy_requests_total{role}
     canary_fraction: Any  # pio_deploy_canary_fraction gauge
+    canary_splitter_acc: Any  # pio_deploy_canary_splitter_acc gauge
     active_version: Any   # pio_deploy_active_release_version gauge
     warmup_shapes: Any    # pio_deploy_warmup_shapes_total counter
 
@@ -253,6 +254,11 @@ def deploy_metrics(registry: Optional[MetricsRegistry] = None
         canary_fraction=reg.gauge(
             "pio_deploy_canary_fraction",
             "Traffic fraction currently routed to the canary (0 = none)"),
+        canary_splitter_acc=reg.gauge(
+            "pio_deploy_canary_splitter_acc",
+            "Canary splitter's error-diffusion accumulator — persisted "
+            "through the telemetry store so a restarted server resumes "
+            "the exact mid-stream split instead of re-seeding at zero"),
         active_version=reg.gauge(
             "pio_deploy_active_release_version",
             "Release version currently serving full traffic (0 = unversioned)"),

@@ -1201,7 +1201,14 @@ class ALSModel:
         an exact host lane would make answers depend on batch size."""
         from predictionio_tpu.ops.scoring import process_scorer_config
 
-        if process_scorer_config().mode != "exact":
+        cfg = process_scorer_config()
+        if cfg.mode != "exact":
+            return False
+        if int(getattr(cfg, "shards", 1) or 1) > 1:
+            # model-parallel serving: the catalog is declared bigger than
+            # one device (ops/scoring.ShardedScorer shards even exact
+            # mode), so the single-host materialized path must not win
+            # the crossover
             return False
         flops = 2.0 * n_rows * len(self.item_vocab) * self.U.shape[1]
         host_s = flops / _host_flops()

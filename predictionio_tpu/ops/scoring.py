@@ -62,7 +62,7 @@ import numpy as np
 
 from predictionio_tpu.ops.bucketing import bucket_size
 from predictionio_tpu.ops.fn_cache import shape_cached_fn
-from predictionio_tpu.ops.topk import host_topk
+from predictionio_tpu.ops.topk import host_topk, merge_topk
 
 logger = logging.getLogger("pio.scoring")
 
@@ -420,8 +420,10 @@ class ItemScorer:
         sc = np.einsum("bk,bsk->bs", u, self._v_host[safe],
                        dtype=np.float32, casting="same_kind")
         sc = np.where(valid, sc, -np.inf)
-        scores, pos = host_topk(sc, k)
-        return scores, np.take_along_axis(cand, pos, axis=1)
+        # the shared shortlist merge (ops/topk): one candidate set is
+        # just a 1-way merge, which buys the deterministic id tie-break
+        # the cross-shard path relies on
+        return merge_topk([(sc, np.where(valid, cand, -1))], k)
 
     def _topk_twostage(self, u: np.ndarray, k: int,
                        mask_pad: Optional[np.ndarray]):
@@ -485,10 +487,13 @@ class ItemScorer:
 
 
 def build_scorer(V: np.ndarray, cfg=None,
-                 min_recall: Optional[float] = None) -> ItemScorer:
+                 min_recall: Optional[float] = None,
+                 device=None) -> ItemScorer:
     """Build an :class:`ItemScorer` over item factors ``V`` [N, K] f32
     under the resolved scorer knobs, running the parity gate before it
-    may serve. ``cfg`` defaults to the process scorer config."""
+    may serve. ``cfg`` defaults to the process scorer config.
+    ``device`` pins the quantized residency to one device of the mesh
+    (the model-parallel sharded path); None keeps jax's default."""
     if cfg is None:
         cfg = process_scorer_config()
     mode = cfg.mode
@@ -542,8 +547,12 @@ def build_scorer(V: np.ndarray, cfg=None,
         cand_per_tile = min(tile, max(1, -(-shortlist // n_tiles)))
         shortlist = cand_per_tile * n_tiles
 
-    tiles_dev = jax.device_put(tiles)
-    scales_dev = jax.device_put(scales) if scales is not None else None
+    tiles_dev = (jax.device_put(tiles, device) if device is not None
+                 else jax.device_put(tiles))
+    scales_dev = None
+    if scales is not None:
+        scales_dev = (jax.device_put(scales, device) if device is not None
+                      else jax.device_put(scales))
     factor_bytes = int(tiles.nbytes
                        + (scales.nbytes if scales is not None else 0))
     scorer = ItemScorer(
@@ -621,6 +630,167 @@ def _observe_build(scorer: ItemScorer) -> None:
 
 
 # ---------------------------------------------------------------------------
+# model-parallel sharded scorer (ALX-style: factors past one device's HBM)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ShardedScorer:
+    """Item factors sharded row-wise over the device mesh, one
+    :class:`ItemScorer` per shard, merged on host.
+
+    The shard map is ``parallel/distributed.contiguous_range`` — the
+    same contiguous disjoint row ranges batchpredict's shard->merge
+    shape uses — so shard ``r`` of ``S`` owns rows ``[lo, hi)`` of ``V``
+    and its residency lands on device ``r % n_devices``. Each shard runs
+    the configured kernel over ITS rows only and emits a local top-k
+    shortlist with exact f32 scores (quantized shards exact-rescore from
+    their host slice, exactly as unsharded); shard-local ids shift by
+    ``lo`` into catalog ids and :func:`ops.topk.merge_topk` folds the
+    shortlists into the global top-k. Because every shard's scores are
+    exact and every catalog row belongs to exactly one shard, a global
+    top-k winner is necessarily inside its own shard's local top-k — so
+    the merge is exact whenever the per-shard kernels are (mode
+    ``exact``/``fused``: always; quantized modes: whenever shortlist
+    membership holds, the same recall contract the unsharded scorer is
+    parity-gated on).
+
+    Mode ``exact`` shards the host BLAS matmul instead of device
+    residency (the dispatch-crossover discipline: exact mode never held
+    device factors to begin with); a shard whose parity gate demoted it
+    likewise serves exact host BLAS over its own rows — per-shard
+    fallback, never a silent whole-catalog degrade.
+    """
+
+    mode: str                  # requested mode
+    active_mode: str           # mode when ALL shards serve it, else "exact"
+    n_items: int
+    rank: int
+    n_shards: int
+    ranges: tuple              # ((lo, hi), ...) per shard
+    shards: tuple              # per-shard ItemScorer; None = exact serving
+    factor_bytes: int          # device-resident bytes across all shards
+    max_shard_factor_bytes: int   # the per-device budget a shard must fit
+    exact_bytes: int
+    recall_probe: float
+    _v_shards: tuple = ()      # per-shard host f32 slices
+
+    @property
+    def active(self) -> bool:
+        """A sharded scorer always serves — a demoted shard falls back
+        to exact host BLAS over its own rows, not to the caller."""
+        return True
+
+    def topk(self, u_batch: np.ndarray, k: int,
+             mask: Optional[np.ndarray] = None
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """Global top-``k`` (scores, catalog ids): per-shard local
+        shortlists merged via the shared k-way merge. ``mask``
+        [B, n_items] slices per shard columns, so a whitelist
+        concentrated in one shard sentinels every other shard entirely
+        and the merge keeps only real survivors."""
+        b = u_batch.shape[0]
+        k = min(k, self.n_items)
+        if k <= 0:
+            empty = np.zeros((b, 0))
+            return empty.astype(np.float32), empty.astype(np.int64)
+        u = np.ascontiguousarray(np.asarray(u_batch, np.float32))
+        shortlists = []
+        for (lo, hi), scorer, v_shard in zip(
+                self.ranges, self.shards, self._v_shards):
+            m = None
+            if mask is not None:
+                m = np.ascontiguousarray(mask[:, lo:hi])
+            k_s = min(k, hi - lo)
+            if scorer is not None and scorer.active:
+                vals, ids = scorer.topk(u, k_s, mask=m)
+            else:
+                sc = u @ v_shard.T
+                if m is not None:
+                    sc = np.where(m, -np.inf, sc)
+                vals, ids = host_topk(sc, k_s)
+            shortlists.append((vals, np.asarray(ids, np.int64) + lo))
+        return merge_topk(shortlists, k)
+
+    def status(self) -> dict:
+        """The /deploy/status.json + bench echo block (sharded form)."""
+        return {
+            "mode": self.mode,
+            "activeMode": self.active_mode,
+            "sharded": True,
+            "shards": self.n_shards,
+            "ranges": [list(r) for r in self.ranges],
+            "items": self.n_items,
+            "rank": self.rank,
+            "factorBytes": self.factor_bytes,
+            "maxShardFactorBytes": self.max_shard_factor_bytes,
+            "exactBytes": self.exact_bytes,
+            "recallProbe": round(self.recall_probe, 4),
+            "shardStatus": [s.status() for s in self.shards
+                            if s is not None],
+        }
+
+
+def build_sharded_scorer(V: np.ndarray, cfg=None,
+                         min_recall: Optional[float] = None,
+                         shards: Optional[int] = None) -> ShardedScorer:
+    """Build a :class:`ShardedScorer` over ``V`` [N, K] f32: row-shard
+    via ``contiguous_range``, build one per-shard kernel under the same
+    config (each parity-gated against ITS shard's exact top-k), then
+    probe the MERGED result against the global exact top-k for the
+    status block's recall figure."""
+    from predictionio_tpu.parallel.distributed import contiguous_range
+
+    if cfg is None:
+        cfg = process_scorer_config()
+    if shards is None:
+        shards = int(getattr(cfg, "shards", 1) or 1)
+    v = np.ascontiguousarray(np.asarray(V), np.float32)
+    n_items, rank = v.shape
+    shards = max(1, min(shards, n_items))
+    devices = jax.devices()
+    ranges, shard_scorers, v_shards = [], [], []
+    for r in range(shards):
+        lo, hi = contiguous_range(n_items, r, shards)
+        v_shard = np.ascontiguousarray(v[lo:hi])
+        scorer = None
+        if cfg.mode != "exact":
+            scorer = build_scorer(v_shard, cfg, min_recall,
+                                  device=devices[r % len(devices)])
+        ranges.append((lo, hi))
+        shard_scorers.append(scorer)
+        v_shards.append(v_shard)
+    all_active = all(s is not None and s.active for s in shard_scorers)
+    factor_bytes = sum(s.factor_bytes for s in shard_scorers
+                       if s is not None)
+    max_shard = max((s.factor_bytes for s in shard_scorers
+                     if s is not None), default=0)
+    out = ShardedScorer(
+        mode=cfg.mode,
+        active_mode=cfg.mode if (all_active and cfg.mode != "exact")
+        else "exact",
+        n_items=n_items, rank=rank, n_shards=shards,
+        ranges=tuple(ranges), shards=tuple(shard_scorers),
+        factor_bytes=factor_bytes, max_shard_factor_bytes=max_shard,
+        exact_bytes=v.nbytes, recall_probe=1.0,
+        _v_shards=tuple(v_shards))
+    # global probe: merged shortlists vs whole-catalog exact top-k (the
+    # per-shard gates already ran inside build_scorer; this one feeds
+    # the status block AND catches a merge regression outright)
+    n = n_items
+    k = min(PARITY_PROBE_K, n)
+    if k > 0:
+        rows = np.linspace(0, n - 1,
+                           num=min(PARITY_PROBE_QUERIES, n)).astype(int)
+        probe = np.ascontiguousarray(v[rows])
+        _, exact_idx = host_topk(probe @ v.T, k)
+        _, got_idx = out.topk(probe, k)
+        hits = sum(len(set(a.tolist()) & set(b.tolist()))
+                   for a, b in zip(exact_idx, got_idx))
+        out.recall_probe = hits / float(exact_idx.shape[0] * k)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # model-side cache + status helpers
 # ---------------------------------------------------------------------------
 
@@ -639,9 +809,12 @@ def scorer_for(holder, V: np.ndarray) -> Optional[ItemScorer]:
     fold-in apply requantize: an item fold swaps V, the identity check
     misses, and the next scored batch (the fold-in controller's pre-swap
     warm drive) rebuilds from the updated rows. Returns ``None`` in
-    exact mode (callers keep the legacy path)."""
+    unsharded exact mode (callers keep the legacy path); with
+    ``shards > 1`` every mode — exact included — routes through the
+    model-parallel :class:`ShardedScorer`."""
     cfg = process_scorer_config()
-    if cfg.mode == "exact":
+    shards = int(getattr(cfg, "shards", 1) or 1)
+    if cfg.mode == "exact" and shards <= 1:
         return None
     key = cfg.cache_key()
     cached = getattr(holder, "_scorer_cache", None)
@@ -650,7 +823,9 @@ def scorer_for(holder, V: np.ndarray) -> Optional[ItemScorer]:
     with _BUILD_LOCK:
         cached = getattr(holder, "_scorer_cache", None)   # lost the race?
         if cached is None or cached[0] is not V or cached[1] != key:
-            cached = (V, key, build_scorer(V, cfg))
+            built = (build_sharded_scorer(V, cfg) if shards > 1
+                     else build_scorer(V, cfg))
+            cached = (V, key, built)
             holder._scorer_cache = cached
     return cached[2]
 

@@ -809,9 +809,14 @@ class QueryServer:
         # (result, vectorized flag, batcher) rides that one reference, so
         # a concurrent swap can never hand it mismatched halves
         role, unit, canary = ROLE_INCUMBENT, self._unit, self._canary
-        if canary is not None and canary.controller.decided is None \
-                and canary.controller.splitter.route():
-            role, unit = ROLE_CANARY, canary.unit
+        if canary is not None and canary.controller.decided is None:
+            if canary.controller.splitter.route():
+                role, unit = ROLE_CANARY, canary.unit
+            # publish the diffusion accumulator so the telemetry scrape
+            # persists it; a restarted server restores the exact
+            # mid-stream split instead of re-seeding at zero
+            self._deploy.canary_splitter_acc.set(
+                canary.controller.splitter.state())
         t_predict = time.perf_counter()
         try:
             # spans resolve through the middleware-installed trace, which
@@ -904,6 +909,26 @@ class QueryServer:
         verdict = canary.controller.observe(role, seconds, ok)
         if verdict is not None:
             self._spawn(self._act_on_verdict(canary, verdict))
+
+    def _restore_canary_splitter(self, controller) -> None:
+        """Re-seed the canary splitter's diffusion accumulator from the
+        durable telemetry store (the restart-skew fix: process-local, a
+        restart mid-canary would re-seed at 0 and skew the realized
+        fraction for the first ~1/fraction queries). The last persisted
+        ``pio_deploy_canary_splitter_acc`` point wins; restore()
+        ignores junk."""
+        if self._telemetry is None:
+            return
+        try:
+            points = [p for info in self._telemetry.reader().series(
+                "pio_deploy_canary_splitter_acc") for p in info.points]
+            if points:
+                controller.splitter.restore(max(points)[1])
+                self._deploy.canary_splitter_acc.set(
+                    controller.splitter.state())
+        except Exception:
+            logger.exception("canary splitter restore failed; starting "
+                             "from a zero accumulator")
 
     async def _shadow_score(self, canary: "CanaryState", query) -> None:
         """Score-but-discard: the candidate sees real traffic shape
@@ -1382,6 +1407,7 @@ class QueryServer:
         cfg = self._canary_config(body)
         if cfg is not None:
             controller = CanaryController(cfg)
+            self._restore_canary_splitter(controller)
             self._canary = CanaryState(unit=unit, controller=controller,
                                        config=controller.config)
             self._deploy.canary_fraction.set(

@@ -349,12 +349,20 @@ class ScorerConfig:
     count per query. Every non-exact scorer is parity-gated at build
     (deploy warm-up) against the exact path and falls back to exact
     below ``min_recall`` recall@10.
+
+    ``shards`` > 1 turns on model-parallel serving
+    (ops/scoring.ShardedScorer): item factors row-shard over the device
+    mesh via ``contiguous_range``, each shard runs the configured
+    kernel over its rows, and the per-shard shortlists k-way merge on
+    host — the catalog-bigger-than-one-device path (README "Serving
+    fleet"). Applies to EVERY mode, exact included.
     """
 
     mode: str = "exact"
     tile_items: int = 16384
     shortlist: int = 512
     min_recall: float = 0.99
+    shards: int = 1
 
     @classmethod
     def from_env(cls, data: Optional[dict] = None,
@@ -379,11 +387,13 @@ class ScorerConfig:
             ("tileItems", "tile_items", int),
             ("shortlist", "shortlist", int),
             ("minRecall", "min_recall", float),
+            ("shards", "shards", int),
         )
         env_keys = (
             ("PIO_SCORER_MODE", "mode", as_mode),
             ("PIO_SCORER_TILE_ITEMS", "tile_items", int),
             ("PIO_SCORER_SHORTLIST", "shortlist", int),
+            ("PIO_SCORER_SHARDS", "shards", int),
         )
         sources = (
             [(k, data.get(k), attr, conv) for k, attr, conv in file_keys]
@@ -403,12 +413,13 @@ class ScorerConfig:
         cfg.tile_items = max(128, cfg.tile_items)
         cfg.shortlist = max(16, cfg.shortlist)
         cfg.min_recall = min(1.0, max(0.0, cfg.min_recall))
+        cfg.shards = max(1, cfg.shards)
         return cfg
 
     def cache_key(self) -> tuple:
         """What invalidates a built scorer when the config changes."""
         return (self.mode, self.tile_items, self.shortlist,
-                self.min_recall)
+                self.min_recall, self.shards)
 
 
 def scorer_config(variant_section: Optional[dict] = None) -> ScorerConfig:
@@ -915,6 +926,183 @@ class DeployConfig:
                 logger.warning("ignoring malformed deploy knob %s=%r",
                                name, raw)
         return cfg
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """Serving-fleet router tuning (the ``PIO_ROUTER_*`` knobs;
+    server.json ``router`` section, camelCase keys; env overrides the
+    file, the established precedence).
+
+    The router (server/router.py, ``pio router``) spreads queries over
+    ``replicas`` query-server replicas with the canary error-diffusion
+    splitter generalized to N arms — exact realized fractions, no RNG.
+    Replicas are health-checked every ``health_interval_s`` against
+    their ``/slo.json`` + ``/deploy/status.json``; one leaves rotation
+    after ``health_fail_after`` consecutive failures and rejoins on the
+    first healthy probe. ``proxy_retries`` is how many OTHER replicas a
+    failed proxy attempt tries before surfacing the error (a replica
+    mid-restart must not fail user queries); ``drain_timeout_s`` bounds
+    how long scale-down waits for a draining replica's in-flight
+    queries. ``base_port`` seeds spawned replicas' ports (replica rank r
+    listens on ``base_port + r``); ``persist_splitter`` restores the
+    error-diffusion accumulators from the durable telemetry store on
+    restart so a restarted router resumes its exact split mid-stream.
+    """
+
+    port: int = 8100
+    replicas: int = 2
+    base_port: int = 8200
+    health_interval_s: float = 2.0
+    health_fail_after: int = 3
+    proxy_retries: int = 1
+    drain_timeout_s: float = 10.0
+    persist_splitter: bool = True
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None) -> "RouterConfig":
+        """server.json ``router`` section overlaid by ``PIO_ROUTER_*``
+        env vars (env wins); malformed knobs are logged and fall back,
+        same contract as ServingConfig."""
+        data = data or {}
+        cfg = cls()
+        as_bool = lambda v: str(v).strip().lower() not in (  # noqa: E731
+            "0", "false", "no", "off", "")
+        file_keys = (
+            ("port", "port", int),
+            ("replicas", "replicas", int),
+            ("basePort", "base_port", int),
+            ("healthIntervalS", "health_interval_s", float),
+            ("healthFailAfter", "health_fail_after", int),
+            ("proxyRetries", "proxy_retries", int),
+            ("drainTimeoutS", "drain_timeout_s", float),
+            ("persistSplitter", "persist_splitter", as_bool),
+        )
+        env_keys = (
+            ("PIO_ROUTER_PORT", "port", int),
+            ("PIO_ROUTER_REPLICAS", "replicas", int),
+            ("PIO_ROUTER_BASE_PORT", "base_port", int),
+            ("PIO_ROUTER_HEALTH_INTERVAL_S", "health_interval_s", float),
+            ("PIO_ROUTER_HEALTH_FAIL_AFTER", "health_fail_after", int),
+            ("PIO_ROUTER_PROXY_RETRIES", "proxy_retries", int),
+            ("PIO_ROUTER_DRAIN_TIMEOUT_S", "drain_timeout_s", float),
+            ("PIO_ROUTER_PERSIST_SPLITTER", "persist_splitter", as_bool),
+        )
+        sources = (
+            [(k, data.get(k), attr, conv) for k, attr, conv in file_keys]
+            + [(k, os.environ.get(k), attr, conv)
+               for k, attr, conv in env_keys]
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed router knob %s=%r",
+                               name, raw)
+        cfg.replicas = max(1, cfg.replicas)
+        cfg.health_interval_s = max(0.05, cfg.health_interval_s)
+        cfg.health_fail_after = max(1, cfg.health_fail_after)
+        cfg.proxy_retries = max(0, cfg.proxy_retries)
+        cfg.drain_timeout_s = max(0.0, cfg.drain_timeout_s)
+        return cfg
+
+
+def router_config() -> RouterConfig:
+    """Resolve the router knobs a ``pio router`` run should use:
+    server.json ``router`` section overlaid by ``PIO_ROUTER_*`` env."""
+    return RouterConfig.from_env(read_server_json().get("router") or {})
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """SLO-driven autoscaling tuning (the ``PIO_FLEET_*`` knobs;
+    server.json ``fleet`` section, camelCase keys; env overrides the
+    file, the established precedence).
+
+    The fleet controller (deploy/fleet.py) runs inside the router
+    process and drives replica count off the durable SLO burn-rate
+    history through the orchestrator's committed-phase-transition
+    discipline: scale UP one replica once the serving SLO has burned
+    for ``burn_sustain_s`` continuously (to at most ``max_replicas``),
+    scale DOWN one replica once fleet-wide QPS has sat under
+    ``idle_qps`` for ``idle_sustain_s`` (to at least ``min_replicas``;
+    the victim drains before it stops — zero dropped queries is the
+    contract). ``cooldown_s`` separates consecutive scaling decisions
+    (flap suppression); ``state_dir`` holds the crash-safe fleet
+    documents (default ``$PIO_HOME/fleet``).
+    """
+
+    enabled: bool = True
+    min_replicas: int = 1
+    max_replicas: int = 4
+    burn_sustain_s: float = 30.0
+    idle_qps: float = 0.5
+    idle_sustain_s: float = 120.0
+    cooldown_s: float = 60.0
+    state_dir: Optional[str] = None
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None) -> "FleetConfig":
+        """server.json ``fleet`` section overlaid by ``PIO_FLEET_*``
+        env vars (env wins); malformed knobs are logged and fall back,
+        same contract as ServingConfig."""
+        data = data or {}
+        cfg = cls()
+        as_bool = lambda v: str(v).strip().lower() not in (  # noqa: E731
+            "0", "false", "no", "off", "")
+        file_keys = (
+            ("enabled", "enabled", as_bool),
+            ("minReplicas", "min_replicas", int),
+            ("maxReplicas", "max_replicas", int),
+            ("burnSustainS", "burn_sustain_s", float),
+            ("idleQps", "idle_qps", float),
+            ("idleSustainS", "idle_sustain_s", float),
+            ("cooldownS", "cooldown_s", float),
+            ("stateDir", "state_dir", str),
+        )
+        env_keys = (
+            ("PIO_FLEET_AUTOSCALE", "enabled", as_bool),
+            ("PIO_FLEET_MIN_REPLICAS", "min_replicas", int),
+            ("PIO_FLEET_MAX_REPLICAS", "max_replicas", int),
+            ("PIO_FLEET_BURN_SUSTAIN_S", "burn_sustain_s", float),
+            ("PIO_FLEET_IDLE_QPS", "idle_qps", float),
+            ("PIO_FLEET_IDLE_SUSTAIN_S", "idle_sustain_s", float),
+            ("PIO_FLEET_COOLDOWN_S", "cooldown_s", float),
+            ("PIO_FLEET_STATE_DIR", "state_dir", str),
+        )
+        sources = (
+            [(k, data.get(k), attr, conv) for k, attr, conv in file_keys]
+            + [(k, os.environ.get(k), attr, conv)
+               for k, attr, conv in env_keys]
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed fleet knob %s=%r",
+                               name, raw)
+        cfg.min_replicas = max(1, cfg.min_replicas)
+        cfg.max_replicas = max(cfg.min_replicas, cfg.max_replicas)
+        cfg.burn_sustain_s = max(0.0, cfg.burn_sustain_s)
+        cfg.idle_qps = max(0.0, cfg.idle_qps)
+        cfg.idle_sustain_s = max(0.0, cfg.idle_sustain_s)
+        cfg.cooldown_s = max(0.0, cfg.cooldown_s)
+        return cfg
+
+    def resolved_state_dir(self) -> str:
+        if self.state_dir:
+            return self.state_dir
+        return os.path.join(pio_home(), "fleet")
+
+
+def fleet_config() -> FleetConfig:
+    """Resolve the autoscaler knobs a fleet controller should use:
+    server.json ``fleet`` section overlaid by ``PIO_FLEET_*`` env."""
+    return FleetConfig.from_env(read_server_json().get("fleet") or {})
 
 
 def read_server_json(path: Optional[str] = None) -> dict:

@@ -442,6 +442,48 @@ def test_bench_topk_scoring_smoke(tmp_path):
         detail["speedup_twostage"]
 
 
+def test_bench_fleet_scaling_smoke(tmp_path):
+    """Smoke the fleet_scaling config at a shrunken scale: the config
+    itself asserts zero dropped queries, the exact error-diffusion
+    spread, and the sharded catalog's budget-fit + exact parity; the
+    emitted detail must carry the per-stage qps/p99 + sharded fields
+    the judged run records. The judged-scale scaling floor is 3x at 4
+    replicas (the tentpole bar); the smoke floor is relaxed — short
+    stages on a busy 2-core CI box measure mostly scheduler noise."""
+    p = _run("fleet_scaling", "300", timeout=280, tmp_path=tmp_path,
+             extra_env={"BENCH_FLEET_SERVICE_MS": "15",
+                        "BENCH_FLEET_STAGE_S": "1.2",
+                        "BENCH_FLEET_MIN_SCALING": "1.5",
+                        "BENCH_FLEET_P99_RATIO": "10",
+                        "BENCH_FLEET_ITEMS": "20000",
+                        "BENCH_FLEET_RANK": "16",
+                        "BENCH_FLEET_SHARDS": "4"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line, got: {lines}"
+    out = json.loads(lines[0])
+    assert "fleet_scaling" in out["unit"]
+    detail = next(d for d in
+                  json.load(open(tmp_path / "details.json"))["details"]
+                  if d["name"] == "fleet_scaling")
+    for key in ("qps_1", "qps_2", "qps_4", "p99_ms_1", "p99_ms_4",
+                "scaling_4", "sharded_parity", "catalog_bytes",
+                "device_budget_bytes", "max_shard_factor_bytes",
+                "service_floor_injected"):
+        assert key in detail, (key, detail)
+    assert detail["scaling_4"] >= 1.5
+    assert detail["service_floor_injected"] is True
+    # the sharded catalog really exceeds the per-device budget its
+    # shards individually fit, and parity to the unsharded scorer held
+    assert detail["max_shard_factor_bytes"] <= \
+        detail["device_budget_bytes"] < detail["catalog_bytes"]
+    assert detail["sharded_parity"] == 1.0
+    # the run landed in the per-config perf-trajectory history
+    history = json.load(open(tmp_path / "BENCH_fleet_scaling.json"))
+    assert len(history) == 1
+    assert history[0]["detail"]["scaling_4"] == detail["scaling_4"]
+
+
 def test_every_bench_config_has_smoke():
     """Static gate: every bench.py config must either have a `_run(...)`
     smoke in this file or a justified HEAVY_EXEMPT entry — future

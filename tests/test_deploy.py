@@ -279,6 +279,65 @@ def test_controller_promotes_clean_window():
     assert verdict == ("promote", "healthy: SLO window clean")
 
 
+def test_canary_splitter_acc_survives_restart(tmp_path):
+    """The restart-skew fix end to end: the splitter accumulator is
+    process-local, so a server restart mid-canary used to re-seed it at
+    zero and skew the realized fraction for the first ~1/fraction
+    queries. The serving path publishes it as the
+    ``pio_deploy_canary_splitter_acc`` gauge, the telemetry loop
+    persists it, and ``_restore_canary_splitter`` feeds it back — a
+    restarted server resumes the EXACT mid-stream split."""
+    import types
+
+    from predictionio_tpu.deploy.warm import deploy_metrics
+    from predictionio_tpu.obs.registry import MetricsRegistry
+    from predictionio_tpu.obs.telemetry import TelemetryRecorder
+    from predictionio_tpu.utils.server_config import TelemetryConfig
+
+    tcfg = TelemetryConfig(dir=str(tmp_path / "telemetry"),
+                           interval_s=60.0)
+    reg1 = MetricsRegistry()
+    rec1 = TelemetryRecorder("pio", tcfg, registries=[reg1])
+    ctl = CanaryController(CanaryConfig(fraction=0.25))
+    routes = [ctl.splitter.route() for _ in range(10)]
+    saved = ctl.splitter.state()
+    # what query_server.handle_query does on every canary-routed query
+    deploy_metrics(reg1).canary_splitter_acc.set(saved)
+    rec1.stop()                     # restart: final scrape + close
+
+    reference = TrafficSplitter(0.25)
+    reference.restore(saved)
+    reg2 = MetricsRegistry()
+    rec2 = TelemetryRecorder("pio", tcfg, registries=[reg2])
+    host = types.SimpleNamespace(_telemetry=rec2,
+                                 _deploy=deploy_metrics(reg2))
+    resumed = CanaryController(CanaryConfig(fraction=0.25))
+    QueryServer._restore_canary_splitter(host, resumed)
+    try:
+        assert resumed.splitter.state() == saved != 0.0
+        # the restored gauge re-publishes, so the next scrape persists it
+        assert host._deploy.canary_splitter_acc.value() == saved
+        expected = [reference.route() for _ in range(40)]
+        assert [resumed.splitter.route() for _ in range(40)] == expected
+        # realized fraction across the restart stays exact
+        assert sum(routes) + sum(expected) == round(50 * 0.25)
+    finally:
+        rec2.stop()
+
+
+def test_canary_splitter_restore_without_telemetry_is_noop():
+    import types
+
+    from predictionio_tpu.deploy.warm import deploy_metrics
+    from predictionio_tpu.obs.registry import MetricsRegistry
+
+    host = types.SimpleNamespace(_telemetry=None,
+                                 _deploy=deploy_metrics(MetricsRegistry()))
+    ctl = CanaryController(CanaryConfig(fraction=0.5))
+    QueryServer._restore_canary_splitter(host, ctl)
+    assert ctl.splitter.state() == 0.0
+
+
 # ---------------------------------------------------------------------------
 # warm swap: the compile-delta acceptance check
 # ---------------------------------------------------------------------------

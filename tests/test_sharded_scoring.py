@@ -1,0 +1,254 @@
+"""Model-parallel sharded scoring (ops/scoring.ShardedScorer) and the
+shared k-way shortlist merge (ops/topk.merge_topk).
+
+Covers the ISSUE's acceptance paths:
+  * merge_topk is the one tested shard->merge implementation:
+    randomized equivalence to a whole-matrix top-k, deterministic
+    tie-break (score desc, id asc — shard-order independent), ragged
+    shortlist widths, k=0 / all-empty, short-row (-inf, -1) padding,
+    invalid-candidate sentinels, ragged-batch rejection;
+  * sharded-vs-unsharded EXACT top-k parity across all five scorer
+    modes, with seen-items masks and with whitelists concentrated
+    inside one shard (every other shard fully sentineled);
+  * the sharded residency math: disjoint covering ranges, per-shard
+    factor bytes under the whole-catalog bytes (the past-one-device's
+    HBM story), quantized shards halving the resident bytes;
+  * scorer_for routes EVERY mode — exact included — through the
+    ShardedScorer when shards > 1.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import scoring
+from predictionio_tpu.ops.scoring import build_sharded_scorer, scorer_for
+from predictionio_tpu.ops.topk import host_topk, merge_topk
+from predictionio_tpu.utils.server_config import ScorerConfig
+
+ALL_MODES = ("exact", "fused", "fused_bf16", "fused_int8", "twostage")
+
+
+@pytest.fixture(autouse=True)
+def _reset_scorer_state():
+    scoring.set_process_scorer_config(None)
+    yield
+    scoring.set_process_scorer_config(None)
+
+
+def _factors(n, k=12, seed=0, decay=1.2):
+    rng = np.random.default_rng(seed)
+    spec = np.power(10.0, -decay * np.arange(k) / max(1, k - 1))
+    return (rng.standard_normal((n, k)) * spec).astype(np.float32)
+
+
+def _cfg(mode, shards, tile=64, shortlist=32):
+    return ScorerConfig(mode=mode, tile_items=tile, shortlist=shortlist,
+                        shards=shards)
+
+
+# ---------------------------------------------------------------------------
+# merge_topk (satellite: the one shard->merge implementation)
+# ---------------------------------------------------------------------------
+
+def test_merge_topk_equals_whole_matrix_topk_randomized():
+    """Slicing a score matrix into per-source shortlists and merging
+    must reproduce the whole-matrix top-k exactly, for any split."""
+    rng = np.random.default_rng(7)
+    for b, n, k, cuts in [(1, 10, 3, [4]), (4, 100, 10, [30, 71]),
+                          (3, 64, 64, [1, 2, 60]), (2, 50, 8, [])]:
+        scores = rng.standard_normal((b, n)).astype(np.float32)
+        bounds = [0] + cuts + [n]
+        shortlists = []
+        for lo, hi in zip(bounds, bounds[1:]):
+            vals, idx = host_topk(scores[:, lo:hi], min(k, hi - lo))
+            shortlists.append((vals, idx.astype(np.int64) + lo))
+        ref_v, ref_i = host_topk(scores, k)
+        out_v, out_i = merge_topk(shortlists, k)
+        assert np.array_equal(out_i, ref_i)
+        assert np.array_equal(out_v, ref_v)
+
+
+def test_merge_topk_tie_break_and_shard_order_independence():
+    """Equal scores resolve by ascending id, whatever order the
+    shortlists arrive in — the merged result is a pure function of the
+    candidate SET."""
+    a = (np.array([[1.0, 1.0]], np.float32), np.array([[7, 3]]))
+    b = (np.array([[1.0, 0.5]], np.float32), np.array([[5, 9]]))
+    for lists in ([a, b], [b, a]):
+        vals, ids = merge_topk(lists, 3)
+        assert ids.tolist() == [[3, 5, 7]]
+        assert vals.tolist() == [[1.0, 1.0, 1.0]]
+
+
+def test_merge_topk_ragged_widths_and_short_row_padding():
+    """Sources may emit different shortlist widths; rows with fewer
+    than k valid candidates pad out with (-inf, -1)."""
+    wide = (np.array([[3.0, 1.0, 0.5]], np.float32),
+            np.array([[0, 1, 2]]))
+    narrow = (np.array([[2.0]], np.float32), np.array([[10]]))
+    vals, ids = merge_topk([wide, narrow], 6)
+    assert ids.tolist() == [[0, 10, 1, 2, -1, -1]]
+    assert vals[0, :4].tolist() == [3.0, 2.0, 1.0, 0.5]
+    assert np.all(np.isneginf(vals[0, 4:]))
+
+
+def test_merge_topk_k_zero_and_empty_inputs():
+    some = (np.array([[1.0]], np.float32), np.array([[0]]))
+    for k in (0, -3):
+        vals, ids = merge_topk([some], k)
+        assert vals.shape == (1, 0) and ids.shape == (1, 0)
+    # all-empty shortlists: B is still known, result is [B, 0]
+    empty = (np.zeros((2, 0), np.float32), np.zeros((2, 0), np.int64))
+    vals, ids = merge_topk([empty, empty], 5)
+    assert vals.shape == (2, 0) and ids.shape == (2, 0)
+    with pytest.raises(ValueError):
+        merge_topk([], 5)
+
+
+def test_merge_topk_invalid_candidates_sort_last():
+    """NaN/-inf scores and negative ids are sentinels (masked slots,
+    padding): never beat a real candidate, never win a tie via id -1."""
+    src = (np.array([[np.nan, 2.0, -np.inf, 1.0]], np.float32),
+           np.array([[0, 1, 2, -5]]))
+    vals, ids = merge_topk([src], 4)
+    assert ids.tolist() == [[1, -1, -1, -1]]
+    assert vals[0, 0] == 2.0 and np.all(np.isneginf(vals[0, 1:]))
+    # a valid 0-score ties nothing: id -1 must not out-sort it
+    tie = (np.array([[0.0, 0.0]], np.float32), np.array([[4, -1]]))
+    vals, ids = merge_topk([tie], 2)
+    assert ids.tolist() == [[4, -1]]
+
+
+def test_merge_topk_rejects_ragged_batch_and_bad_shapes():
+    ok = (np.ones((2, 3), np.float32), np.zeros((2, 3), np.int64))
+    bad_batch = (np.ones((3, 3), np.float32), np.zeros((3, 3), np.int64))
+    with pytest.raises(ValueError, match="ragged batch"):
+        merge_topk([ok, bad_batch], 2)
+    mismatched = (np.ones((2, 3), np.float32), np.zeros((2, 2), np.int64))
+    with pytest.raises(ValueError, match="match"):
+        merge_topk([mismatched], 2)
+
+
+# ---------------------------------------------------------------------------
+# sharded-vs-unsharded parity (tentpole: model-parallel serving)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ALL_MODES)
+def test_sharded_parity_all_modes(mode):
+    """The gate the ISSUE names: for every scorer mode the sharded
+    scorer's (scores, ids) equal the whole-catalog exact top-k — the
+    per-shard kernels emit exact f32 scores for their shortlists and
+    every global winner lives in its own shard's local top-k."""
+    V = _factors(500, 16, seed=1)
+    U = _factors(9, 16, seed=2)
+    sharded = build_sharded_scorer(V, _cfg(mode, shards=3), shards=3)
+    ref_v, ref_i = host_topk(U @ V.T, 10)
+    out_v, out_i = sharded.topk(U, 10)
+    assert np.array_equal(np.asarray(out_i), ref_i), mode
+    assert np.allclose(np.asarray(out_v), ref_v, rtol=1e-5,
+                       atol=1e-5), mode
+    st = sharded.status()
+    assert st["sharded"] is True and st["shards"] == 3
+    assert st["recallProbe"] == 1.0
+
+
+@pytest.mark.parametrize("mode", ALL_MODES)
+def test_sharded_parity_with_seen_items_mask(mode):
+    """Seen-item exclusion masks slice per shard columns and survive
+    the merge: masked ids never appear, parity holds on the rest."""
+    rng = np.random.default_rng(5)
+    V = _factors(300, 12, seed=3)
+    U = _factors(6, 12, seed=4)
+    mask = rng.random((6, 300)) < 0.3          # True = excluded
+    sharded = build_sharded_scorer(V, _cfg(mode, shards=4), shards=4)
+    scores = U @ V.T
+    ref_v, ref_i = host_topk(np.where(mask, -np.inf, scores), 8)
+    out_v, out_i = sharded.topk(U, 8, mask=mask)
+    assert np.array_equal(np.asarray(out_i), ref_i), mode
+    assert np.allclose(np.asarray(out_v), ref_v, rtol=1e-5,
+                       atol=1e-5), mode
+    assert not np.take_along_axis(mask, np.asarray(out_i), axis=1).any()
+
+
+@pytest.mark.parametrize("mode", ALL_MODES)
+def test_sharded_whitelist_concentrated_in_one_shard(mode):
+    """A whitelist living entirely inside ONE shard sentinels every
+    other shard's whole shortlist; the merge must keep only the real
+    survivors and pad the remainder with (-inf, -1) rather than let a
+    sentinel through."""
+    V = _factors(400, 12, seed=6)
+    U = _factors(4, 12, seed=7)
+    sharded = build_sharded_scorer(V, _cfg(mode, shards=4), shards=4)
+    (lo, hi) = sharded.ranges[2]               # whitelist inside shard 2
+    allowed = np.arange(lo + 1, min(lo + 6, hi))
+    mask = np.ones((4, 400), bool)
+    mask[:, allowed] = False
+    scores = U @ V.T
+    ref_v, ref_i = host_topk(np.where(mask, -np.inf, scores), 10)
+    out_v, out_i = sharded.topk(U, 10, mask=mask)
+    out_i = np.asarray(out_i)
+    # every returned real id is whitelisted; rows pad past the
+    # whitelist's width
+    n_allowed = len(allowed)
+    assert np.array_equal(out_i[:, :n_allowed], ref_i[:, :n_allowed]), mode
+    assert set(out_i[:, :n_allowed].ravel()) <= set(allowed.tolist())
+    assert np.all(out_i[:, n_allowed:] == -1)
+    assert np.all(np.isneginf(np.asarray(out_v)[:, n_allowed:]))
+
+
+def test_sharded_residency_fits_per_device_budget():
+    """The reason to shard at all: each shard's device-resident bytes
+    are ~1/S of the whole catalog (so a catalog larger than one
+    device's budget serves from S devices), ranges tile the catalog
+    disjointly, and int8 shards still halve the f32 bytes."""
+    V = _factors(1000, 16, seed=8)
+    sharded = build_sharded_scorer(V, _cfg("fused", shards=4), shards=4)
+    st = sharded.status()
+    assert st["exactBytes"] == V.nbytes
+    # ~1/S of the catalog plus at most one tile of padding per shard
+    per_shard_rows = 1000 // 4 + 64
+    assert st["maxShardFactorBytes"] <= per_shard_rows * 16 * 4
+    assert st["maxShardFactorBytes"] < st["exactBytes"] // 2
+    bounds = [lo for lo, _ in sharded.ranges] + [sharded.ranges[-1][1]]
+    assert bounds[0] == 0 and bounds[-1] == 1000
+    assert all(a < b for a, b in zip(bounds, bounds[1:]))
+    q = build_sharded_scorer(V, _cfg("fused_int8", shards=4), shards=4)
+    assert q.status()["factorBytes"] * 2 <= V.nbytes
+
+
+def test_sharded_more_shards_than_convenient_rows():
+    """Ragged guard: shard count is clamped to the row count and tiny
+    trailing shards (single-row ranges) still merge exactly."""
+    V = _factors(5, 8, seed=9)
+    U = _factors(3, 8, seed=10)
+    sharded = build_sharded_scorer(V, _cfg("fused", shards=64), shards=64)
+    assert sharded.n_shards == 5
+    ref_v, ref_i = host_topk(U @ V.T, 5)
+    out_v, out_i = sharded.topk(U, 5)
+    assert np.array_equal(np.asarray(out_i), ref_i)
+    # k past the catalog clamps, k=0 answers empty
+    v0, i0 = sharded.topk(U, 0)
+    assert v0.shape == (3, 0) and i0.shape == (3, 0)
+
+
+def test_scorer_for_routes_exact_mode_through_shards():
+    """Unsharded exact mode keeps the legacy host path (None); with
+    shards > 1 EVERY mode — exact included — serves through the
+    model-parallel ShardedScorer."""
+
+    class Holder:
+        pass
+
+    V = _factors(120, 8, seed=11)
+    scoring.set_process_scorer_config(_cfg("exact", shards=1))
+    assert scorer_for(Holder(), V) is None
+    holder = Holder()
+    scoring.set_process_scorer_config(_cfg("exact", shards=3))
+    sharded = scorer_for(holder, V)
+    assert sharded is not None and sharded.n_shards == 3
+    assert sharded.status()["activeMode"] == "exact"
+    ref_v, ref_i = host_topk(_factors(2, 8, seed=12) @ V.T, 6)
+    out_v, out_i = sharded.topk(_factors(2, 8, seed=12), 6)
+    assert np.array_equal(np.asarray(out_i), ref_i)
+    # same V + same config: the cache returns the SAME scorer object
+    assert scorer_for(holder, V) is sharded

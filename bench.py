@@ -1718,28 +1718,21 @@ def cfg_ingest_write(jax, mesh, platform):
 
     def run_grouped(store, events, registry):
         """The new path: open-loop submits with a bounded outstanding
-        window; ack latency is submit -> future resolved."""
+        window; ack latency is submit -> future resolved. The drive
+        itself is the shared loadtest harness (loadtest/harness.py) —
+        the same discipline the workload simulator storms with."""
+        from predictionio_tpu.loadtest.harness import drive_open_loop
+
         buf = WriteBuffer(store_fn=lambda: store, flush_max=512,
                           linger_s=0.002, queue_max=1 << 20,
                           registry=registry)
-        outstanding = threading.Semaphore(1024)
-        lat, done, n = [], threading.Event(), len(events)
-        t0 = time.perf_counter()
-        for e in events:
-            outstanding.acquire()
-
-            def ack(_f, t_s=time.perf_counter()):
-                lat.append(time.perf_counter() - t_s)  # writer thread only
-                if len(lat) == n:
-                    done.set()
-                outstanding.release()
-
-            buf.submit([e], APP).add_done_callback(ack)
-        assert done.wait(600), "grouped ingest did not complete"
-        wall = time.perf_counter() - t0
+        res = drive_open_loop(events, lambda e: buf.submit([e], APP),
+                              max_outstanding=1024, timeout_s=600)
         buf.stop()
-        lat.sort()
-        return n / wall, lat[int(0.99 * len(lat))] * 1000
+        assert not res.timed_out, "grouped ingest did not complete"
+        assert res.dropped == 0 and res.failed == 0, (
+            f"grouped ingest dropped={res.dropped} failed={res.failed}")
+        return res.events_per_s(), res.ledger.percentile_ms(99)
 
     for backend in backends:
         # per-request side needs far fewer events for a stable rate —
@@ -1815,24 +1808,25 @@ def cfg_ingest_write(jax, mesh, platform):
             buf = WriteBuffer(store_fn=lambda: walled, flush_max=256,
                               linger_s=0.004, queue_max=1 << 20,
                               partitions=parts, registry=MetricsRegistry())
+            from predictionio_tpu.loadtest.harness import drive_open_loop
+
             events = build_events(n_scale)
-            outstanding = threading.BoundedSemaphore(24)
-            futures = []
-            t0 = time.perf_counter()
-            for i in range(0, n_scale, 256):
-                outstanding.acquire()
-                f = buf.submit(events[i:i + 256], APP)
-                f.add_done_callback(lambda _f: outstanding.release())
-                futures.append(f)
-            for f in futures:
-                f.result(timeout=600)
-            wall = time.perf_counter() - t0
+            batches = [events[i:i + 256]
+                       for i in range(0, n_scale, 256)]
+            res = drive_open_loop(
+                batches, lambda b: buf.submit(b, APP),
+                max_outstanding=24, weight=len, timeout_s=600)
             buf.stop()
+            assert not res.timed_out and res.dropped == 0 \
+                and res.failed == 0, (
+                    f"partitioned ingest (P={parts}) dropped="
+                    f"{res.dropped} failed={res.failed} "
+                    f"timed_out={res.timed_out}")
             # exactly-once at every curve point, through the lane split
             assert store.find_columnar(APP).num_rows == n_scale, \
                 f"partitioned ingest (P={parts}) lost or duplicated events"
             store.close()
-            return n_scale / wall
+            return res.events_per_s()
         finally:
             shutil.rmtree(root, ignore_errors=True)
 
@@ -2904,7 +2898,9 @@ def cfg_fleet_scaling(jax, mesh, platform):
         await client.start_server()
         for rank_ in list(router.replicas):
             assert await router.wait_replica_healthy(rank_, timeout_s=10)
-        latencies = []
+        from predictionio_tpu.loadtest.harness import LatencyLedger
+
+        ledger = LatencyLedger()     # the shared stage accounting
         done = 0
         deadline = time.perf_counter() + stage_s
 
@@ -2916,7 +2912,7 @@ def cfg_fleet_scaling(jax, mesh, platform):
                         "/queries.json", json={"user": "u1"}) as resp:
                     await resp.read()
                     assert resp.status == 200, resp.status
-                latencies.append(time.perf_counter() - t0)
+                ledger.record(time.perf_counter() - t0)
                 done += 1
 
         clients = [one_client()
@@ -2932,7 +2928,7 @@ def cfg_fleet_scaling(jax, mesh, platform):
         for runner in runners:
             await runner.cleanup()
         qps = done / elapsed
-        p99 = float(np.percentile(latencies, 99)) * 1000.0
+        p99 = ledger.percentile_ms(99)
         return qps, p99, dropped, spread
 
     qps_by_n = {}
@@ -3010,6 +3006,136 @@ def cfg_fleet_scaling(jax, mesh, platform):
     return detail
 
 
+def cfg_loadtest(jax, mesh, platform):
+    """Workload simulator end-to-end (loadtest/): the whole paper's
+    serving story under one sustained, mixed, incident-laden storm.
+
+    Leg 1 (sustained): a LocalFleet — real event server (group-commit
+    WriteBuffer, partitioned lanes), two QueryServer replicas with
+    online fold-in, the router tier, and the continuous-training
+    orchestrator — stormed at the largest CPU-feasible population
+    (BENCH_LOADTEST_POPULATION lazy Zipfian users) with the 60/30/10
+    events/queries/feedback mix on a diurnal arrival curve, while the
+    orchestrator completes a FULL retrain-and-promote cycle mid-run and
+    the router rolls the promoted release across the fleet. Asserts the
+    runtime invariants live: zero dropped acks/queries, exactly-once
+    ingest by post-run audit against the emitter's acked-id ledger, one
+    LIVE release after the dust settles, retrain promoted mid-run, ack
+    and query p99 under BENCH_LOADTEST_P99_MS, and fold-in freshness
+    (rows applied, event->applied p95 bounded).
+
+    Leg 2 (chaos, parquet): the same fleet on the parquet backend
+    survives a replica kill + restart (router ejects with backed-off
+    probes, re-admits on recovery) AND a compaction crash (storage kill
+    point mid-rewrite, recovery rolls forward) mid-storm — with zero
+    dropped acks and the exactly-once audit still clean."""
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.loadtest.fleet import LocalFleet
+    from predictionio_tpu.loadtest.scenario import Scenario
+    from predictionio_tpu.loadtest.simulator import run_storm
+
+    population = int(os.environ.get("BENCH_LOADTEST_POPULATION", 200_000))
+    items = int(os.environ.get("BENCH_LOADTEST_ITEMS", 20_000))
+    duration_s = float(os.environ.get("BENCH_LOADTEST_DURATION_S", 24))
+    rate = float(os.environ.get("BENCH_LOADTEST_RATE", 400))
+    chaos_s = float(os.environ.get("BENCH_LOADTEST_CHAOS_DURATION_S", 16))
+    chaos_rate = float(os.environ.get("BENCH_LOADTEST_CHAOS_RATE", 150))
+    p99_bound_ms = float(os.environ.get("BENCH_LOADTEST_P99_MS", 2000))
+    detail = {"population": population, "items": items,
+              "duration_s": duration_s, "base_rate": rate,
+              "p99_bound_ms": p99_bound_ms}
+    t_start = time.perf_counter()
+
+    def run_one(sc, label, **kw):
+        root = tempfile.mkdtemp(prefix=f"pio_bench_lt_{label}_")
+        fleet = LocalFleet(root, replicas=sc.replicas,
+                           partitions=sc.partitions, backend=sc.backend)
+        try:
+            fleet.start()
+            return run_storm(sc, fleet,
+                             ack_p99_bound_ms=p99_bound_ms,
+                             query_p99_bound_ms=p99_bound_ms, **kw)
+        finally:
+            fleet.stop()
+            shutil.rmtree(root, ignore_errors=True)
+
+    def fails(report):
+        return [r for r in report["invariants"] if not r["ok"]]
+
+    # -- leg 1: sustained mixed workload + mid-run retrain-and-promote ----
+    hb("loadtest sustained storm")
+    sustained = Scenario.from_dict({
+        "name": "bench-sustained",
+        "population": population, "items": items,
+        "durationS": duration_s, "seed": 7,
+        "baseRate": rate, "amplitude": 0.5,
+        "mix": {"events": 0.6, "queries": 0.3, "feedback": 0.1},
+        "replicas": 2, "partitions": 2, "backend": "sqlite",
+        "maxOutstanding": 256,
+        "incidents": [{"kind": "retrain", "atS": round(duration_s * 0.4, 1)}],
+    })
+    rep1 = run_one(sustained, "sustained")
+    lanes = rep1["lanes"]
+    detail["sustained_arrivals"] = rep1["arrivals"]
+    detail["sustained_active_users"] = rep1["active_users"]
+    detail["sustained_wall_s"] = rep1["wall_s"]
+    for lane, res in lanes.items():
+        detail[f"sustained_{lane}_acked"] = res["acked"]
+        detail[f"sustained_{lane}_p99_ms"] = res["ack_p99_ms"]
+    detail["sustained_audited_events"] = rep1["audit"]["expected"]
+    detail["foldin_applied_rows"] = rep1["foldin_applied_rows"]
+    ops_s = (sum(r["acked"] for r in lanes.values())
+             / max(1e-9, rep1["wall_s"]))
+    detail["sustained_ops_per_s"] = round(ops_s, 1)
+    assert rep1["ok"], (
+        f"sustained storm violated invariants: {fails(rep1)}")
+
+    # -- leg 2: chaos storm on parquet (kill replica + kill compaction) ---
+    hb("loadtest chaos storm")
+    chaos = Scenario.from_dict({
+        "name": "bench-chaos",
+        "population": max(1000, population // 10),
+        "items": max(200, items // 10),
+        "durationS": chaos_s, "seed": 11,
+        "baseRate": chaos_rate, "amplitude": 0.3,
+        "mix": {"events": 0.7, "queries": 0.25, "feedback": 0.05},
+        "replicas": 2, "partitions": 2, "backend": "parquet",
+        "maxOutstanding": 128,
+        "incidents": [
+            {"kind": "kill_replica", "atS": round(chaos_s * 0.25, 1),
+             "target": 1, "restartAfterS": round(chaos_s * 0.3, 1)},
+            {"kind": "kill_compaction", "atS": round(chaos_s * 0.55, 1)},
+        ],
+    })
+    # freshness is leg 1's assertion; the chaos leg is about survival
+    rep2 = run_one(chaos, "chaos", check_freshness=False)
+    detail["chaos_arrivals"] = rep2["arrivals"]
+    detail["chaos_events_acked"] = rep2["lanes"]["events"]["acked"]
+    detail["chaos_audited_events"] = rep2["audit"]["expected"]
+    detail["chaos_audit_ok"] = rep2["audit"]["ok"]
+    assert rep2["ok"], f"chaos storm violated invariants: {fails(rep2)}"
+
+    detail.update({
+        "elapsed_s": round(time.perf_counter() - t_start, 2),
+        "baseline_s": None,
+        "speedup_headline": detail["sustained_ops_per_s"],
+        "note": (
+            f"sustained storm: {rep1['arrivals']} arrivals over "
+            f"{population} users, {detail['sustained_ops_per_s']} ops/s "
+            f"acked (ack p99 "
+            f"{detail['sustained_events_p99_ms']}ms), retrain promoted "
+            f"mid-run, exactly-once over "
+            f"{detail['sustained_audited_events']} events, "
+            f"{detail['foldin_applied_rows']} rows folded in; chaos "
+            f"storm (parquet): replica kill+restart and compaction "
+            f"crash survived with zero dropped acks, exactly-once over "
+            f"{detail['chaos_audited_events']} events"),
+    })
+    return detail
+
+
 def cfg_sleep_forever(jax, mesh, platform):
     """Test-only config (never in the default set): wedges the worker so
     the orchestrator's watchdog + ladder can be exercised on CPU."""
@@ -3036,6 +3162,7 @@ CONFIGS = {
     "telemetry": (cfg_telemetry, 240),
     "topk_scoring": (cfg_topk_scoring, 240),
     "fleet_scaling": (cfg_fleet_scaling, 300),
+    "loadtest": (cfg_loadtest, 420),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
 

@@ -100,6 +100,7 @@ KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
     "PIO_ROUTER_HEALTH_FAIL_AFTER": (SERVER_CONFIG_PATH,),
     "PIO_ROUTER_PROXY_RETRIES": (SERVER_CONFIG_PATH,),
     "PIO_ROUTER_DRAIN_TIMEOUT_S": (SERVER_CONFIG_PATH,),
+    "PIO_ROUTER_HEALTH_BACKOFF_CAP_S": (SERVER_CONFIG_PATH,),
     "PIO_ROUTER_PERSIST_SPLITTER": (SERVER_CONFIG_PATH,),
     # SLO-driven autoscaler knob chain (env > server.json "fleet") —
     # resolved by FleetConfig in server_config
@@ -111,6 +112,15 @@ KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
     "PIO_FLEET_IDLE_SUSTAIN_S": (SERVER_CONFIG_PATH,),
     "PIO_FLEET_COOLDOWN_S": (SERVER_CONFIG_PATH,),
     "PIO_FLEET_STATE_DIR": (SERVER_CONFIG_PATH,),
+    # workload-simulator knob chain (env > server.json "loadtest") —
+    # resolved by LoadtestConfig in server_config; scales a scenario
+    # file (population / duration / rate) without editing it
+    "PIO_LOADTEST_POPULATION": (SERVER_CONFIG_PATH,),
+    "PIO_LOADTEST_DURATION_S": (SERVER_CONFIG_PATH,),
+    "PIO_LOADTEST_RATE_SCALE": (SERVER_CONFIG_PATH,),
+    "PIO_LOADTEST_SEED": (SERVER_CONFIG_PATH,),
+    "PIO_LOADTEST_OUTSTANDING": (SERVER_CONFIG_PATH,),
+    "PIO_LOADTEST_REPORT_DIR": (SERVER_CONFIG_PATH,),
 }
 
 #: knob *families* read via pattern scan (no literal name per knob) —

@@ -613,6 +613,105 @@ def router(variant, ip, port, replicas, replica_urls, accesskey):
                replica_urls=replica_urls)
 
 
+@cli.command()
+@click.option("--scenario", "-s", "scenario_path", default=None,
+              help="Scenario JSON (loadtest/scenario.py schema); "
+                   "omit to run the built-in example scenario.")
+@click.option("--example", "show_example", is_flag=True,
+              help="Print an example scenario file and exit.")
+@click.option("--dir", "workdir", default=None,
+              help="Fleet working directory (default: a temp dir, "
+                   "removed afterwards).")
+@click.option("--report", "report_path", default=None,
+              help="Write the verdict JSON here (default "
+                   "PIO_LOADTEST_REPORT_DIR/<scenario>.json when the "
+                   "knob is set, else stdout only).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Print the full report JSON instead of the summary.")
+def loadtest(scenario_path, show_example, workdir, report_path, as_json):
+    """Storm a full in-process fleet (loadtest/) with synthetic mixed
+    traffic — events, queries, feedback — under a declarative scenario
+    (Zipfian population, diurnal arrivals, injected incidents) and
+    assert the runtime invariants live: no dropped acks, exactly-once
+    ingest by post-run audit, one LIVE release, freshness SLO held.
+    Exit status is the verdict."""
+    import os
+    import tempfile
+
+    from predictionio_tpu.loadtest.scenario import (
+        Scenario, ScenarioError, example_scenario,
+    )
+
+    if show_example:
+        click.echo(json.dumps(example_scenario(), indent=2, sort_keys=True))
+        return
+
+    try:
+        if scenario_path:
+            sc = Scenario.load(scenario_path)
+        else:
+            sc = Scenario.from_dict(example_scenario())
+    except ScenarioError as e:
+        click.echo(f"[ERROR] bad scenario: {e}")
+        sys.exit(1)
+
+    from predictionio_tpu.loadtest.fleet import LocalFleet
+    from predictionio_tpu.loadtest.simulator import (
+        run_storm, storm_report_json,
+    )
+    from predictionio_tpu.utils.server_config import loadtest_config
+
+    knobs = loadtest_config()
+    knobs.apply(sc)
+
+    tmp = None
+    if workdir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="pio-loadtest-")
+        workdir = tmp.name
+    click.echo(f"[INFO] Storm '{sc.name}': population={sc.population} "
+               f"duration={sc.duration_s:g}s rate={sc.base_rate:g}/s "
+               f"replicas={sc.replicas} partitions={sc.partitions} "
+               f"backend={sc.backend} incidents={len(sc.incidents)}")
+    fleet = LocalFleet(workdir, replicas=sc.replicas,
+                       partitions=sc.partitions, backend=sc.backend)
+    try:
+        fleet.start()
+        report = run_storm(sc, fleet)
+    finally:
+        fleet.stop()
+        if tmp is not None:
+            tmp.cleanup()
+
+    if report_path is None and knobs.report_dir:
+        os.makedirs(knobs.report_dir, exist_ok=True)
+        report_path = os.path.join(knobs.report_dir, f"{sc.name}.json")
+    if report_path:
+        tmp_report = f"{report_path}.tmp"
+        with open(tmp_report, "w") as f:
+            f.write(storm_report_json(report) + "\n")
+        os.replace(tmp_report, report_path)
+        click.echo(f"[INFO] Report written to {report_path}")
+
+    if as_json:
+        click.echo(storm_report_json(report))
+    else:
+        for lane, res in sorted(report["lanes"].items()):
+            click.echo(
+                f"[INFO] lane {lane}: offered={res['offered']} "
+                f"acked={res['acked']} failed={res['failed']} "
+                f"p99={res['ack_p99_ms']:.1f}ms")
+        for inv in report["invariants"]:
+            mark = "ok " if inv["ok"] else "FAIL"
+            click.echo(f"[{mark.upper().strip()}] {inv['name']}: "
+                       f"{inv['detail']}")
+    if not report["ok"]:
+        click.echo("[ERROR] storm verdict: INVARIANT VIOLATED")
+        sys.exit(1)
+    click.echo(f"[INFO] storm verdict: OK "
+               f"({report['arrivals']} arrivals, "
+               f"{report['wall_s']:.1f}s wall)")
+
+
 def _release_of_instance(engine_id, variant_id, instance_id):
     """The release manifest registered for an instance, if any (pre-
     release-registry instances deploy fine without one)."""

@@ -249,6 +249,22 @@ def register_drift_release(base: Release) -> Optional[Release]:
     try:
         releases = Storage.get_meta_data_releases()
         releases.insert(drift)
+        # a FLEET folds in concurrently: N replicas each reach their
+        # first apply over the same base and each insert a drift row.
+        # Converge on one — every replica keeps the lowest-versioned
+        # LIVE row for this generation and retires the rest; the store
+        # serializes the inserts, so whichever replica commits later
+        # sees both rows and the fleet agrees on the winner.
+        peers = sorted(
+            (r for r in releases.get_all()
+             if r.status == "LIVE" and r.batch == drift.batch),
+            key=lambda r: r.version)
+        for extra in peers[1:]:
+            releases.set_status(
+                extra.id, "RETIRED",
+                reason=f"duplicate drift row; v{peers[0].version} wins")
+        if peers and peers[0].id != drift.id:
+            drift = peers[0]
         releases.set_status(base.id, "RETIRED",
                             reason=f"superseded: fold-in drift v"
                                    f"{drift.version}")

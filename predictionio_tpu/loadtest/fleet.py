@@ -1,0 +1,588 @@
+"""The fleet under test: every production subsystem, in one process.
+
+:class:`LocalFleet` assembles the REAL components — not stubs — the
+way an operator would deploy them, scaled to one box:
+
+* a partitioned event store (``PIO_INGEST_PARTITIONS`` commit lanes)
+  behind the real :class:`EventServer` (group-commit WriteBuffer,
+  429 shedding, batch API) on a real port;
+* N :class:`QueryServer` replicas serving a REAL trained
+  recommendation engine (ALS), each with online fold-in enabled, on
+  fixed ports (fixed so a killed replica can restart at the SAME url
+  and the router's re-admission path is exercised, not side-stepped);
+* the :class:`Router` tier fronting them (error-diffusion spread,
+  health ejection with backed-off probes, per-query retry, sequenced
+  fleet cutovers);
+* the continuous-training :class:`Orchestrator` (registry plane +
+  SLO-judged canary) whose promote the fleet then rolls out through
+  the router's sequenced ``/deploy.json`` — the full Lambda loop
+  closing mid-storm.
+
+Everything rides ONE background asyncio loop thread; the simulator's
+lanes talk to it over real HTTP through ``run_coroutine_threadsafe``
+futures, which is exactly the Future shape the open-loop harness
+drives.
+
+Incident levers (what scenario.py timelines trigger):
+``kill_replica`` / ``restart_replica`` (AppRunner down/up on the same
+port), ``kill_compaction`` (arm a storage kill point, run a partition
+compaction into it, let recovery roll forward), ``run_retrain_cycle``
+(a forced orchestrator tick + sequenced router cutover of the
+promoted release).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["LocalFleet"]
+
+#: events the batch endpoint accepts per request (the fleet raises the
+#: reference's 50 cap for bulk emitters — one knob, disclosed in detail)
+BATCH_MAX = 256
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class LocalFleet:
+    """See module docstring. Lifecycle: ``start()`` (seeds data, trains
+    the first release via a forced orchestrator cycle, boots event
+    server + replicas + router) ... lanes + incidents ... ``stop()``."""
+
+    def __init__(self, root: str, *, replicas: int = 2,
+                 partitions: int = 2, backend: str = "sqlite",
+                 app_name: str = "loadtest", seed_events: int = 160,
+                 foldin: bool = True,
+                 foldin_interval_s: float = 1.0,
+                 health_interval_s: float = 0.1,
+                 health_backoff_cap_s: float = 1.0,
+                 queue_max: int = 1 << 17):
+        self.root = str(root)
+        self.n_replicas = int(replicas)
+        self.partitions = int(partitions)
+        self.backend = backend
+        self.app_name = app_name
+        self.seed_events = int(seed_events)
+        self.foldin = foldin
+        self.foldin_interval_s = foldin_interval_s
+        self.health_interval_s = health_interval_s
+        self.health_backoff_cap_s = health_backoff_cap_s
+        self.queue_max = queue_max
+
+        self.app_id: Optional[int] = None
+        self.access_key = "storm-key"
+        self.event_url: Optional[str] = None
+        self.router_url: Optional[str] = None
+        self.replica_urls: List[str] = []
+        self.cycles: List = []            #: CycleDocs from retrain incidents
+        self.seed_event_ids: List[str] = []
+
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._session = None              # aiohttp ClientSession (loop-owned)
+        self._event_runner = None
+        self._router = None
+        self._router_runner = None
+        self._replica_ports: List[int] = []
+        self._replica_runners: List[Optional[object]] = []
+        self._replica_servers: List[Optional[object]] = []
+        self._orch = None
+        self._variant_path: Optional[str] = None
+        self._saved_env: Dict[str, Optional[str]] = {}
+        self._event_server = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        self._set_env("PIO_INGEST_PARTITIONS",
+                      str(self.partitions) if self.partitions > 1 else None)
+        self._configure_storage()
+        self._seed_app_and_data()
+        self._write_configs()
+        self._start_loop()
+        self._build_orchestrator()
+        # cycle 0 (pre-storm): train + promote the first LIVE release the
+        # replicas deploy from — the operator's `pio train` analog
+        doc0 = self._orch.tick(force=True)
+        assert doc0 is not None and doc0.outcome == "promoted", (
+            f"seed training cycle failed: "
+            f"{getattr(doc0, 'reason', 'no cycle ran')}")
+        self._start_event_server()
+        self._replica_ports = [_free_port() for _ in range(self.n_replicas)]
+        self._replica_runners = [None] * self.n_replicas
+        self._replica_servers = [None] * self.n_replicas
+        for rank in range(self.n_replicas):
+            self._start_replica(rank)
+        self._start_router()
+
+    def stop(self) -> None:
+        from predictionio_tpu.storage import Storage
+        from predictionio_tpu.storage.faults import set_kill_points
+
+        try:
+            if self._loop is not None:
+                self._run(self._shutdown_all(), timeout=30)
+        except Exception:
+            logger.exception("fleet shutdown raised")
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._loop_thread is not None:
+                self._loop_thread.join(timeout=10)
+            self._loop.close()
+            self._loop = None
+        set_kill_points([])
+        try:
+            Storage.get_events().close()
+        except Exception:
+            pass
+        Storage.reset()
+        for key, old in self._saved_env.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+        self._saved_env.clear()
+
+    # -- plumbing ------------------------------------------------------------
+    def _set_env(self, key: str, value: Optional[str]) -> None:
+        if key not in self._saved_env:
+            self._saved_env[key] = os.environ.get(key)
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = value
+
+    def _configure_storage(self) -> None:
+        from predictionio_tpu.data.eventstore import clear_cache
+        from predictionio_tpu.storage import Storage
+
+        sources = {"DB": {"TYPE": "sqlite",
+                          "PATH": os.path.join(self.root, "meta.db")}}
+        if self.backend == "parquet":
+            sources["EVENTS"] = {
+                "TYPE": "parquet",
+                "PATH": os.path.join(self.root, "events")}
+        else:
+            sources["EVENTS"] = {
+                "TYPE": "sqlite",
+                "PATH": os.path.join(self.root, "events.db")}
+        Storage.configure({
+            "sources": sources,
+            "repositories": {
+                "METADATA": {"SOURCE": "DB", "NAMESPACE": "pio_meta"},
+                "MODELDATA": {"SOURCE": "DB", "NAMESPACE": "pio_model"},
+                "EVENTDATA": {"SOURCE": "EVENTS", "NAMESPACE": "pio_event"},
+            }})
+        clear_cache()
+
+    def _seed_app_and_data(self) -> None:
+        import datetime as dt
+        import random
+
+        from predictionio_tpu.data.event import UTC, Event
+        from predictionio_tpu.storage import AccessKey, App, Storage
+
+        apps = Storage.get_meta_data_apps()
+        self.app_id = apps.insert(App(id=0, name=self.app_name))
+        Storage.get_meta_data_access_keys().insert(
+            AccessKey(key=self.access_key, appid=self.app_id, events=()))
+        Storage.get_events().init_channel(self.app_id)
+        # seed ratings: enough signal for the first ALS fit
+        rng = random.Random(11)
+        base = dt.datetime(2026, 7, 1, tzinfo=UTC)
+        events = [Event(
+            event="rate", entity_type="user",
+            entity_id=f"u{rng.randrange(40)}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.randrange(60)}",
+            properties={"rating": 1.0 + rng.random() * 4.0},
+            event_time=base + dt.timedelta(seconds=i))
+            for i in range(self.seed_events)]
+        # the seed ids join the audit ledger: they were "acked" by this
+        # insert, so the post-run identity audit expects them too
+        self.seed_event_ids = list(
+            Storage.get_events().insert_batch(events, self.app_id))
+
+    def _write_configs(self) -> None:
+        self._variant_path = os.path.join(self.root, "engine.json")
+        with open(self._variant_path, "w") as f:
+            json.dump({
+                "id": "default",
+                "engineFactory":
+                    "predictionio_tpu.engines.recommendation:engine",
+                "datasource": {"params": {"app_name": self.app_name}},
+                "algorithms": [{
+                    "name": "als",
+                    "params": {"rank": 4, "num_iterations": 3,
+                               "reg": 0.05, "seed": 3}}],
+            }, f)
+        smoke_path = os.path.join(self.root, "smoke.jsonl")
+        with open(smoke_path, "w") as f:
+            f.write("".join(
+                json.dumps({"user": f"u{i}", "num": 3}) + "\n"
+                for i in range(5)))
+        self._smoke_path = smoke_path
+        server_conf = os.path.join(self.root, "server.json")
+        with open(server_conf, "w") as f:
+            json.dump({"slo": {
+                "objectives": [
+                    {"name": "errs", "kind": "errors", "budget": 0.02},
+                    {"name": "p99", "kind": "latency",
+                     "thresholdMs": 2000, "budget": 0.05}],
+                "windows": [{"seconds": 60, "burnThreshold": 1.0}],
+                "evalIntervalS": 0.05}}, f)
+        self._set_env("PIO_SERVER_CONF", server_conf)
+
+    def _start_loop(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        ready = threading.Event()
+
+        def _spin():
+            asyncio.set_event_loop(self._loop)
+            ready.set()
+            self._loop.run_forever()
+
+        self._loop_thread = threading.Thread(
+            target=_spin, name="loadtest-fleet-loop", daemon=True)
+        self._loop_thread.start()
+        ready.wait(10)
+
+        async def _mk_session():
+            import aiohttp
+
+            return aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60))
+
+        self._session = self._run(_mk_session(), timeout=10)
+
+    def _run(self, coro, timeout: float = 60.0):
+        """Run a coroutine on the fleet loop from any thread, blocking."""
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._loop).result(timeout)
+
+    def _submit(self, coro):
+        """Fire a coroutine on the fleet loop, returning the concurrent
+        Future the open-loop harness drives."""
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    # -- components ----------------------------------------------------------
+    def _build_orchestrator(self) -> None:
+        from predictionio_tpu.deploy.orchestrator import (
+            OrchestratorConfig, build_orchestrator,
+        )
+
+        cfg = OrchestratorConfig(
+            min_ingest_events=0, cooldown_s=0.0, phase_retries=0,
+            phase_timeout_s=300.0, canary_hold_s=0.0,
+            smoke_queries=self._smoke_path)
+        self._orch = build_orchestrator(
+            self._variant_path, config=cfg,
+            state_dir=os.path.join(self.root, "orch_state"))
+
+    def _start_event_server(self) -> None:
+        from aiohttp import web
+
+        from predictionio_tpu.obs.registry import MetricsRegistry
+        from predictionio_tpu.server.event_server import EventServer
+        from predictionio_tpu.utils.server_config import IngestConfig
+
+        ingest = IngestConfig(
+            buffer=True, queue_max=self.queue_max, flush_max=512,
+            linger_s=0.002, partitions=self.partitions,
+            max_events_per_batch=BATCH_MAX)
+        self._event_server = EventServer(
+            registry=MetricsRegistry(), ingest=ingest)
+        port = _free_port()
+
+        async def _up():
+            runner = web.AppRunner(self._event_server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            return runner
+
+        self._event_runner = self._run(_up(), timeout=30)
+        self.event_url = f"http://127.0.0.1:{port}"
+
+    def _build_replica_server(self):
+        """One QueryServer serving the current LIVE release — the
+        in-process `pio deploy` (cli/main.py deploy), with fold-in."""
+        from predictionio_tpu.core.base import load_class
+        from predictionio_tpu.obs.registry import MetricsRegistry
+        from predictionio_tpu.server.query_server import QueryServer
+        from predictionio_tpu.storage import Storage
+        from predictionio_tpu.utils.server_config import (
+            DeployConfig, FoldinConfig, ServingConfig,
+        )
+        from predictionio_tpu.workflow.train import load_for_deploy
+
+        with open(self._variant_path) as f:
+            variant = json.load(f)
+        factory = load_class(variant["engineFactory"])
+        engine = factory() if callable(factory) else factory.apply()
+        release = Storage.get_meta_data_releases().latest(
+            variant["engineFactory"], "1", variant.get("id", "default"),
+            status="LIVE")
+        assert release is not None, "no LIVE release to deploy from"
+        instance = Storage.get_meta_data_engine_instances().get(
+            release.instance_id)
+        result, ctx = load_for_deploy(engine, instance)
+        return QueryServer(
+            engine, result, instance, ctx,
+            registry=MetricsRegistry(),
+            serving_config=ServingConfig(batch_max=16, batch_linger_s=0.0,
+                                         batch_inflight=2),
+            deploy_config=DeployConfig(warmup=True),
+            release=release,
+            foldin_config=FoldinConfig(
+                enabled=self.foldin,
+                apply_interval_s=self.foldin_interval_s,
+                max_pending=2048))
+
+    def _start_replica(self, rank: int) -> None:
+        from aiohttp import web
+
+        server = self._build_replica_server()
+        port = self._replica_ports[rank]
+
+        async def _up():
+            runner = web.AppRunner(server.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            return runner
+
+        self._replica_runners[rank] = self._run(_up(), timeout=60)
+        self._replica_servers[rank] = server
+        url = f"http://127.0.0.1:{port}"
+        if len(self.replica_urls) <= rank:
+            self.replica_urls.append(url)
+
+    def _start_router(self) -> None:
+        from aiohttp import web
+
+        from predictionio_tpu.obs.registry import MetricsRegistry
+        from predictionio_tpu.server.router import Router
+        from predictionio_tpu.utils.server_config import RouterConfig
+
+        self._router = Router(
+            RouterConfig(health_interval_s=self.health_interval_s,
+                         health_fail_after=2, proxy_retries=2,
+                         health_backoff_cap_s=self.health_backoff_cap_s),
+            registry=MetricsRegistry(),
+            replica_urls=list(self.replica_urls))
+        port = _free_port()
+
+        async def _up():
+            runner = web.AppRunner(self._router.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            return runner
+
+        self._router_runner = self._run(_up(), timeout=30)
+        self.router_url = f"http://127.0.0.1:{port}"
+        for rank in list(self._router.replicas):
+            assert self._router_wait_healthy(rank, 30), (
+                f"replica {rank} never became healthy behind the router")
+
+    def _router_wait_healthy(self, rank: int, timeout_s: float) -> bool:
+        async def _wait():
+            return await self._router.wait_replica_healthy(
+                rank, timeout_s=timeout_s)
+
+        return self._run(_wait(), timeout=timeout_s + 10)
+
+    async def _shutdown_all(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+        if self._router_runner is not None:
+            await self._router_runner.cleanup()
+        for runner in self._replica_runners:
+            if runner is not None:
+                await runner.cleanup()
+        if self._event_runner is not None:
+            await self._event_runner.cleanup()
+
+    # -- traffic lanes -------------------------------------------------------
+    def submit_event_batch(self, payloads: List[dict]):
+        """POST one batch to the REAL event server; the returned Future
+        resolves to the acked event ids (the emitter's audit ledger).
+        429 shed responses retry after the server's own Retry-After —
+        shed is backpressure, not loss, and the open-loop window is what
+        bounds how hard we push."""
+        return self._submit(self._post_events(payloads))
+
+    async def _post_events(self, payloads: List[dict]) -> List[str]:
+        url = (f"{self.event_url}/batch/events.json"
+               f"?accessKey={self.access_key}")
+        for attempt in range(60):
+            async with self._session.post(url, json=payloads) as resp:
+                body = await resp.json()
+                if resp.status == 429:
+                    retry_after = float(
+                        resp.headers.get("Retry-After", 0.1) or 0.1)
+                    await asyncio.sleep(min(max(retry_after, 0.02), 0.5))
+                    continue
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"batch ingest HTTP {resp.status}: {body}")
+                ids = []
+                for entry in body:
+                    if entry.get("status") != 201:
+                        raise RuntimeError(f"event rejected: {entry}")
+                    ids.append(entry["eventId"])
+                return ids
+        raise RuntimeError("batch ingest shed 60 times — queue_max too "
+                           "small for the offered load")
+
+    def submit_query(self, payload: dict):
+        """POST one query through the router; resolves to the parsed
+        response body (raises on non-200 so failures are counted)."""
+        return self._submit(self._post_query(payload))
+
+    async def _post_query(self, payload: dict) -> dict:
+        url = f"{self.router_url}/queries.json"
+        async with self._session.post(url, json=payload) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(f"query HTTP {resp.status}: {body}")
+            return body
+
+    # -- incidents -----------------------------------------------------------
+    def kill_replica(self, rank: int) -> None:
+        """Stop a replica's server mid-storm: its port goes dead, the
+        router's probes must eject it (with backoff) and in-flight
+        queries must retry onto the survivors."""
+        runner = self._replica_runners[rank]
+        self._replica_runners[rank] = None
+        self._replica_servers[rank] = None
+        if runner is not None:
+            async def _down():
+                await runner.cleanup()
+
+            self._run(_down(), timeout=30)
+
+    def restart_replica(self, rank: int) -> None:
+        """Restart a killed replica at the SAME url; the router's
+        health loop must re-admit it."""
+        self._start_replica(rank)
+
+    def kill_compaction(self) -> None:
+        """Arm a compaction kill point and run a partition compaction
+        into it — the in-process ``kill -9`` mid-maintenance. Recovery
+        rolls forward on the next store operation; the post-run audit
+        proves no event was lost or duplicated. Parquet-backed stores
+        only (sqlite compaction is a single DELETE — nothing to kill)."""
+        from predictionio_tpu.storage import Storage
+        from predictionio_tpu.storage.faults import (
+            CrashError, set_kill_points,
+        )
+
+        if self.backend != "parquet":
+            logger.info("kill_compaction skipped: backend=%s", self.backend)
+            return
+        set_kill_points(["compact:pending-written"])
+        try:
+            Storage.get_events().compact(self.app_id)
+            raise AssertionError(
+                "compaction kill point armed but never hit")
+        except CrashError:
+            pass
+        finally:
+            set_kill_points([])
+
+    def run_retrain_cycle(self):
+        """The mid-storm Lambda loop: one forced orchestrator cycle
+        (train -> eval gate -> smoke -> SLO-judged canary -> promote),
+        then the promoted release rolled across the fleet through the
+        router's SEQUENCED /deploy.json — replicas cut over one at a
+        time while queries keep flowing."""
+        doc = self._orch.tick(force=True)
+        self.cycles.append(doc)
+        if doc is not None and doc.outcome == "promoted":
+            try:
+                self._run(self._fleet_cutover(doc.candidate_release_id),
+                          timeout=120)
+            except Exception:
+                logger.exception("sequenced fleet cutover failed")
+        return doc
+
+    async def _fleet_cutover(self, release_id: str) -> dict:
+        url = f"{self.router_url}/deploy.json"
+        async with self._session.post(
+                url, json={"releaseId": release_id}) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"fleet cutover HTTP {resp.status}: {body}")
+            return body
+
+    # -- post-run surfaces ---------------------------------------------------
+    def event_store(self):
+        from predictionio_tpu.storage import Storage
+
+        return Storage.get_events()
+
+    def releases(self):
+        from predictionio_tpu.storage import Storage
+
+        return Storage.get_meta_data_releases()
+
+    def drain_ingest(self, timeout_s: float = 60.0) -> None:
+        """Wait for the event server's WriteBuffer to drain so the
+        post-run audit scans a settled store."""
+        buf = getattr(self._event_server, "buffer", None)
+        if buf is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            depth = getattr(buf, "queue_depth", None)
+            try:
+                if depth is None or not depth():
+                    return
+            except TypeError:
+                return
+            time.sleep(0.05)
+
+    def foldin_applied_rows(self) -> int:
+        total = 0
+        for server in self._replica_servers:
+            ctrl = getattr(server, "_foldin", None) if server else None
+            if ctrl is not None:
+                total += int(getattr(ctrl, "applied_users", 0))
+                total += int(getattr(ctrl, "applied_items", 0))
+        return total
+
+    def foldin_freshness_p95_s(self) -> Optional[float]:
+        """p95 of event→applied seconds across replicas, from the
+        fold-in histogram — None when no applies happened."""
+        best = []
+        for server in self._replica_servers:
+            if server is None:
+                continue
+            hist = server.registry.get("pio_foldin_event_to_applied_seconds")
+            if hist is None:
+                continue
+            try:
+                q = hist.quantile(0.95)
+            except Exception:
+                q = None
+            if q is not None:
+                best.append(float(q))
+        return max(best) if best else None

@@ -1,0 +1,254 @@
+"""Declarative storm scenarios: one JSON file describes the whole run.
+
+A scenario names the population (size, catalog, skew), the arrival
+curve (base rate, diurnal amplitude/period), the traffic mix
+(events / queries / feedback fractions), the fleet shape (replicas,
+partitions, backend), and a timeline of injected **incidents** — the
+chaos the run must survive with its invariants intact:
+
+* ``kill_replica``    — stop a replica's server mid-storm (the router
+  must eject it with backed-off probes and retry its queries
+  elsewhere); ``restartAfterS`` restarts it on the SAME port and the
+  router must re-admit it.
+* ``kill_compaction`` — arm a storage kill point and run a partition
+  compaction so it crashes mid-rewrite; recovery must roll forward
+  with zero lost or duplicated events (the post-run audit proves it).
+* ``burn_slo``        — force replica SLO burn (probes see
+  ``breached: true``) for ``durationS`` seconds.
+* ``degrade_quality`` — make served slates deliberately stale/bad so
+  the orchestrator's data-driven triggers have a reason to retrain.
+* ``retrain``         — force an orchestrator cycle at ``atS`` (the
+  deterministic way to assert retrain-and-promote completes mid-run).
+
+Validation is strict and path-labelled: unknown keys, unknown incident
+kinds, wrong types, out-of-range times all raise :class:`ScenarioError`
+naming the offending path — a scenario file that parses is a scenario
+file that runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+__all__ = ["ScenarioError", "Incident", "Scenario"]
+
+INCIDENT_KINDS = ("kill_replica", "kill_compaction", "burn_slo",
+                  "degrade_quality", "retrain")
+
+
+class ScenarioError(ValueError):
+    """A malformed scenario file; the message names the JSON path."""
+
+
+def _expect(cond: bool, path: str, msg: str) -> None:
+    if not cond:
+        raise ScenarioError(f"{path}: {msg}")
+
+
+def _num(d: dict, key: str, path: str, default=None, lo=None, hi=None):
+    v = d.get(key, default)
+    _expect(isinstance(v, (int, float)) and not isinstance(v, bool),
+            f"{path}.{key}", f"expected a number, got {v!r}")
+    if lo is not None:
+        _expect(v >= lo, f"{path}.{key}", f"must be >= {lo}, got {v!r}")
+    if hi is not None:
+        _expect(v <= hi, f"{path}.{key}", f"must be <= {hi}, got {v!r}")
+    return v
+
+
+def _int(d: dict, key: str, path: str, default=None, lo=None, hi=None) -> int:
+    v = _num(d, key, path, default=default, lo=lo, hi=hi)
+    _expect(float(v).is_integer(), f"{path}.{key}",
+            f"expected an integer, got {v!r}")
+    return int(v)
+
+
+def _reject_unknown(d: dict, allowed: set, path: str) -> None:
+    unknown = set(d) - allowed
+    _expect(not unknown, path,
+            f"unknown key(s) {sorted(unknown)} (allowed: {sorted(allowed)})")
+
+
+@dataclasses.dataclass
+class Incident:
+    """One timeline entry. ``target`` is the replica rank for
+    ``kill_replica``; ``restart_after_s`` restarts it that many seconds
+    after the kill (0 = never restart)."""
+
+    kind: str
+    at_s: float
+    target: int = 0
+    restart_after_s: float = 0.0
+    duration_s: float = 0.0
+
+    _ALLOWED = {"kind", "atS", "target", "restartAfterS", "durationS"}
+
+    @classmethod
+    def from_dict(cls, d: dict, path: str, duration_s: float) -> "Incident":
+        _expect(isinstance(d, dict), path, f"expected an object, got {d!r}")
+        _reject_unknown(d, cls._ALLOWED, path)
+        kind = d.get("kind")
+        _expect(kind in INCIDENT_KINDS, f"{path}.kind",
+                f"unknown incident kind {kind!r} "
+                f"(one of {list(INCIDENT_KINDS)})")
+        at_s = _num(d, "atS", path, lo=0.0)
+        _expect(at_s <= duration_s, f"{path}.atS",
+                f"incident at {at_s}s is past the scenario's "
+                f"{duration_s}s duration")
+        inc = cls(
+            kind=kind, at_s=float(at_s),
+            target=_int(d, "target", path, default=0, lo=0),
+            restart_after_s=float(
+                _num(d, "restartAfterS", path, default=0.0, lo=0.0)),
+            duration_s=float(
+                _num(d, "durationS", path, default=0.0, lo=0.0)))
+        if kind != "kill_replica":
+            _expect("restartAfterS" not in d, f"{path}.restartAfterS",
+                    f"only kill_replica incidents restart, not {kind}")
+        return inc
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind, "atS": self.at_s}
+        if self.target:
+            d["target"] = self.target
+        if self.restart_after_s:
+            d["restartAfterS"] = self.restart_after_s
+        if self.duration_s:
+            d["durationS"] = self.duration_s
+        return d
+
+
+@dataclasses.dataclass
+class Scenario:
+    """The validated storm description. Camel-case keys in the file
+    (the repo's server.json convention), snake-case attributes here."""
+
+    name: str = "storm"
+    population: int = 10_000
+    items: int = 2_000
+    duration_s: float = 20.0
+    seed: int = 7
+    base_rate: float = 200.0          #: arrivals/s at the diurnal mean
+    amplitude: float = 0.5
+    period_s: float = 0.0             #: 0 = one full day-curve per run
+    mix_events: float = 0.6
+    mix_queries: float = 0.3
+    mix_feedback: float = 0.1
+    replicas: int = 2
+    partitions: int = 2
+    backend: str = "sqlite"
+    max_outstanding: int = 256
+    incidents: List[Incident] = dataclasses.field(default_factory=list)
+
+    _ALLOWED = {"name", "population", "items", "durationS", "seed",
+                "baseRate", "amplitude", "periodS", "mix", "replicas",
+                "partitions", "backend", "maxOutstanding", "incidents"}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Scenario":
+        _expect(isinstance(d, dict), "$", f"expected an object, got {d!r}")
+        _reject_unknown(d, cls._ALLOWED, "$")
+        name = d.get("name", "storm")
+        _expect(isinstance(name, str) and name, "$.name",
+                f"expected a non-empty string, got {name!r}")
+        duration_s = float(_num(d, "durationS", "$", default=20.0, lo=0.5))
+        mix = d.get("mix", {"events": 0.6, "queries": 0.3, "feedback": 0.1})
+        _expect(isinstance(mix, dict), "$.mix",
+                f"expected an object, got {mix!r}")
+        _reject_unknown(mix, {"events", "queries", "feedback"}, "$.mix")
+        me = _num(mix, "events", "$.mix", default=0.0, lo=0.0, hi=1.0)
+        mq = _num(mix, "queries", "$.mix", default=0.0, lo=0.0, hi=1.0)
+        mf = _num(mix, "feedback", "$.mix", default=0.0, lo=0.0, hi=1.0)
+        _expect(abs(me + mq + mf - 1.0) < 1e-6, "$.mix",
+                f"fractions must sum to 1.0, got {me + mq + mf:g}")
+        backend = d.get("backend", "sqlite")
+        _expect(backend in ("sqlite", "parquet"), "$.backend",
+                f"expected 'sqlite' or 'parquet', got {backend!r}")
+        incidents_raw = d.get("incidents", [])
+        _expect(isinstance(incidents_raw, list), "$.incidents",
+                f"expected an array, got {incidents_raw!r}")
+        incidents = [
+            Incident.from_dict(item, f"$.incidents[{i}]", duration_s)
+            for i, item in enumerate(incidents_raw)]
+        incidents.sort(key=lambda inc: inc.at_s)
+        sc = cls(
+            name=name,
+            population=_int(d, "population", "$", default=10_000, lo=1),
+            items=_int(d, "items", "$", default=2_000, lo=1),
+            duration_s=duration_s,
+            seed=_int(d, "seed", "$", default=7, lo=0),
+            base_rate=float(_num(d, "baseRate", "$", default=200.0,
+                                 lo=0.001)),
+            amplitude=float(_num(d, "amplitude", "$", default=0.5,
+                                 lo=0.0, hi=1.0)),
+            period_s=float(_num(d, "periodS", "$", default=0.0, lo=0.0)),
+            mix_events=float(me), mix_queries=float(mq),
+            mix_feedback=float(mf),
+            replicas=_int(d, "replicas", "$", default=2, lo=1, hi=16),
+            partitions=_int(d, "partitions", "$", default=2, lo=1, hi=64),
+            backend=backend,
+            max_outstanding=_int(d, "maxOutstanding", "$", default=256,
+                                 lo=1),
+            incidents=incidents)
+        for i, inc in enumerate(incidents):
+            if inc.kind == "kill_replica":
+                _expect(inc.target < sc.replicas,
+                        f"$.incidents[{i}].target",
+                        f"replica {inc.target} does not exist "
+                        f"(fleet has {sc.replicas})")
+        return sc
+
+    @classmethod
+    def load(cls, path: str) -> "Scenario":
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ScenarioError(f"{path}: not valid JSON: {e}") from e
+        return cls.from_dict(data)
+
+    @property
+    def effective_period_s(self) -> float:
+        """The day-curve period actually used: an explicit ``periodS``,
+        else one full cycle compressed into the run."""
+        return self.period_s if self.period_s > 0 else self.duration_s
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "population": self.population,
+            "items": self.items, "durationS": self.duration_s,
+            "seed": self.seed, "baseRate": self.base_rate,
+            "amplitude": self.amplitude, "periodS": self.period_s,
+            "mix": {"events": self.mix_events, "queries": self.mix_queries,
+                    "feedback": self.mix_feedback},
+            "replicas": self.replicas, "partitions": self.partitions,
+            "backend": self.backend,
+            "maxOutstanding": self.max_outstanding,
+            "incidents": [inc.to_dict() for inc in self.incidents],
+        }
+
+
+def example_scenario() -> dict:
+    """The scenario ``pio loadtest --example`` prints — a small chaos
+    storm that kills replica 1 mid-run and restarts it."""
+    return {
+        "name": "example-chaos",
+        "population": 50_000,
+        "items": 5_000,
+        "durationS": 30.0,
+        "seed": 7,
+        "baseRate": 300.0,
+        "amplitude": 0.5,
+        "mix": {"events": 0.6, "queries": 0.3, "feedback": 0.1},
+        "replicas": 2,
+        "partitions": 2,
+        "backend": "sqlite",
+        "maxOutstanding": 256,
+        "incidents": [
+            {"kind": "kill_replica", "atS": 8.0, "target": 1,
+             "restartAfterS": 6.0},
+            {"kind": "retrain", "atS": 12.0},
+        ],
+    }

@@ -644,7 +644,11 @@ class QueryServer:
             if unit.batcher is not None:
                 await unit.batcher.shutdown()
         self._predict_executor.shutdown(wait=False)
-        self._deploy_executor.shutdown(wait=False)
+        # join, not fire-and-forget: an in-flight fold-in apply on this
+        # executor reads the event store — it must finish BEFORE the
+        # caller tears shared state (Storage config) down under it
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._deploy_executor.shutdown(wait=True))
         # lineage writes drain: the last status transition of a shutdown
         # (a rollback's ROLLED_BACK) must land before the process exits
         await asyncio.get_running_loop().run_in_executor(

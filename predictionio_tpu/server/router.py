@@ -153,6 +153,10 @@ class ReplicaHandle:
     inflight: int = 0
     slo: Optional[dict] = None
     deploy_status: Optional[dict] = None
+    #: monotonic instant before which the health loop skips this
+    #: replica — exponential probe backoff for a dead port (a killed
+    #: replica must not be hammered at health_interval_s forever)
+    next_probe_at: float = 0.0
 
     def to_json(self) -> dict:
         active = (self.deploy_status or {}).get("active") or {}
@@ -443,16 +447,28 @@ class Router:
             handle.fails += 1
             self._health_total.inc(replica=str(handle.rank),
                                    outcome="fail")
+            # exponential backoff: a replica that keeps failing gets
+            # probed at interval, 2x, 4x ... capped — a dead port is
+            # not hammered at health_interval_s, and one successful
+            # probe resets the schedule (re-admission stays bounded by
+            # the cap, not by how long the replica was down)
+            backoff = min(
+                self.cfg.health_backoff_cap_s,
+                self.cfg.health_interval_s * (2 ** max(0, handle.fails - 1)))
+            handle.next_probe_at = time.monotonic() + backoff
             if handle.healthy \
                     and handle.fails >= self.cfg.health_fail_after:
                 handle.healthy = False
                 self._rebuild_weights()
                 logger.warning("replica %d ejected after %d failed "
-                               "probes", handle.rank, handle.fails)
+                               "probes (probe backoff up to %.1fs)",
+                               handle.rank, handle.fails,
+                               self.cfg.health_backoff_cap_s)
             return False
         handle.slo = slo if isinstance(slo, dict) else None
         handle.deploy_status = status if isinstance(status, dict) else None
         handle.fails = 0
+        handle.next_probe_at = 0.0
         self._health_total.inc(replica=str(handle.rank), outcome="ok")
         if not handle.healthy:
             handle.healthy = True
@@ -462,8 +478,9 @@ class Router:
     async def _health_loop(self) -> None:
         while True:
             await asyncio.sleep(self.cfg.health_interval_s)
+            now = time.monotonic()
             for handle in list(self.replicas.values()):
-                if handle.draining:
+                if handle.draining or now < handle.next_probe_at:
                     continue
                 try:
                     await self._probe(handle)

@@ -78,7 +78,7 @@ def default_config(home: Optional[str] = None) -> Dict:
 class Storage:
     """Lazy, cached accessors for all data objects (Storage.scala:401-454)."""
 
-    _lock = threading.Lock()
+    _lock = threading.RLock()
     _config: Optional[Dict] = None
     _clients: Dict[str, object] = {}
     _objects: Dict[str, object] = {}
@@ -188,28 +188,39 @@ class Storage:
     @classmethod
     def _get(cls, repository: str, kind: str):
         cache_key = f"{repository}:{kind}"
-        if cache_key in cls._objects:
-            return cls._objects[cache_key]
-        conf = cls.config()
-        repo = conf["repositories"].get(repository)
-        if not repo:
-            raise StorageError(f"repository {repository} is not configured")
-        source_name = repo["SOURCE"]
-        source = cls._source_conf(repository)
-        stype = source.get("TYPE", "sqlite")
-        client = cls._client(source_name)
-        obj = _construct(stype, kind, client, source)
-        if kind == "events":
-            obj = _maybe_partition(stype, client, obj)
-            from predictionio_tpu.storage import faults
+        obj = cls._objects.get(cache_key)
+        if obj is not None:
+            return obj
+        # the whole check-then-construct is under the (reentrant) class
+        # lock: two threads racing the first access must not each build
+        # a store — a partitioned events object built against a config
+        # mid-swap can otherwise leak an unpartitioned view to one thread
+        with cls._lock:
+            obj = cls._objects.get(cache_key)
+            if obj is not None:
+                return obj
+            conf = cls.config()
+            repo = conf["repositories"].get(repository)
+            if not repo:
+                raise StorageError(
+                    f"repository {repository} is not configured")
+            source_name = repo["SOURCE"]
+            source = cls._source_conf(repository)
+            stype = source.get("TYPE", "sqlite")
+            client = cls._client(source_name)
+            obj = _construct(stype, kind, client, source)
+            if kind == "events":
+                obj = _maybe_partition(stype, client, obj)
+                from predictionio_tpu.storage import faults
 
-            if faults.env_enabled():
-                # chaos mode: any PIO_FAULT_* knob wraps the event store
-                # in the fault injector (storage/faults.py) — evaluated
-                # once per cache fill, so arm the env before first use
-                obj = faults.FaultyEvents.from_env(obj)
-        cls._objects[cache_key] = obj
-        return obj
+                if faults.env_enabled():
+                    # chaos mode: any PIO_FAULT_* knob wraps the event
+                    # store in the fault injector (storage/faults.py) —
+                    # evaluated once per cache fill, so arm the env
+                    # before first use
+                    obj = faults.FaultyEvents.from_env(obj)
+            cls._objects[cache_key] = obj
+            return obj
 
     # -- accessors (Storage.scala:401-454 parity) ---------------------------
     @classmethod

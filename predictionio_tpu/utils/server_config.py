@@ -940,7 +940,12 @@ class RouterConfig:
     Replicas are health-checked every ``health_interval_s`` against
     their ``/slo.json`` + ``/deploy/status.json``; one leaves rotation
     after ``health_fail_after`` consecutive failures and rejoins on the
-    first healthy probe. ``proxy_retries`` is how many OTHER replicas a
+    first healthy probe; while it KEEPS failing, its probes back off
+    exponentially (interval, 2x, 4x, ... capped at
+    ``health_backoff_cap_s``) so a dead port is not hammered at
+    ``health_interval_s`` forever — the cap bounds how stale a
+    restarted replica's re-admission can be. ``proxy_retries`` is how
+    many OTHER replicas a
     failed proxy attempt tries before surfacing the error (a replica
     mid-restart must not fail user queries); ``drain_timeout_s`` bounds
     how long scale-down waits for a draining replica's in-flight
@@ -955,6 +960,7 @@ class RouterConfig:
     base_port: int = 8200
     health_interval_s: float = 2.0
     health_fail_after: int = 3
+    health_backoff_cap_s: float = 30.0
     proxy_retries: int = 1
     drain_timeout_s: float = 10.0
     persist_splitter: bool = True
@@ -974,6 +980,7 @@ class RouterConfig:
             ("basePort", "base_port", int),
             ("healthIntervalS", "health_interval_s", float),
             ("healthFailAfter", "health_fail_after", int),
+            ("healthBackoffCapS", "health_backoff_cap_s", float),
             ("proxyRetries", "proxy_retries", int),
             ("drainTimeoutS", "drain_timeout_s", float),
             ("persistSplitter", "persist_splitter", as_bool),
@@ -984,6 +991,8 @@ class RouterConfig:
             ("PIO_ROUTER_BASE_PORT", "base_port", int),
             ("PIO_ROUTER_HEALTH_INTERVAL_S", "health_interval_s", float),
             ("PIO_ROUTER_HEALTH_FAIL_AFTER", "health_fail_after", int),
+            ("PIO_ROUTER_HEALTH_BACKOFF_CAP_S", "health_backoff_cap_s",
+             float),
             ("PIO_ROUTER_PROXY_RETRIES", "proxy_retries", int),
             ("PIO_ROUTER_DRAIN_TIMEOUT_S", "drain_timeout_s", float),
             ("PIO_ROUTER_PERSIST_SPLITTER", "persist_splitter", as_bool),
@@ -1004,6 +1013,9 @@ class RouterConfig:
         cfg.replicas = max(1, cfg.replicas)
         cfg.health_interval_s = max(0.05, cfg.health_interval_s)
         cfg.health_fail_after = max(1, cfg.health_fail_after)
+        # the cap can never undercut one interval (backoff only grows)
+        cfg.health_backoff_cap_s = max(cfg.health_interval_s,
+                                       cfg.health_backoff_cap_s)
         cfg.proxy_retries = max(0, cfg.proxy_retries)
         cfg.drain_timeout_s = max(0.0, cfg.drain_timeout_s)
         return cfg
@@ -1103,6 +1115,94 @@ def fleet_config() -> FleetConfig:
     """Resolve the autoscaler knobs a fleet controller should use:
     server.json ``fleet`` section overlaid by ``PIO_FLEET_*`` env."""
     return FleetConfig.from_env(read_server_json().get("fleet") or {})
+
+
+@dataclasses.dataclass
+class LoadtestConfig:
+    """Workload-simulator tuning (the ``PIO_LOADTEST_*`` knobs;
+    server.json ``loadtest`` section, camelCase keys; env overrides
+    the file, the established precedence).
+
+    These scale a scenario file without editing it: ``population``
+    and ``duration_s`` override the scenario's own values when set
+    (> 0), ``rate_scale`` multiplies its arrival rate (CI shrinks a
+    production storm to a smoke storm by setting it well below 1),
+    ``seed`` re-seeds the whole run, ``max_outstanding`` bounds the
+    open-loop in-flight window per lane, and ``report_dir`` is where
+    ``pio loadtest`` persists the verdict JSON (empty -> stdout only).
+    """
+
+    population: int = 0
+    duration_s: float = 0.0
+    rate_scale: float = 1.0
+    seed: int = -1
+    max_outstanding: int = 0
+    report_dir: str = ""
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None) -> "LoadtestConfig":
+        """server.json ``loadtest`` section overlaid by
+        ``PIO_LOADTEST_*`` env vars (env wins); malformed knobs are
+        logged and fall back, same contract as ServingConfig."""
+        data = data or {}
+        cfg = cls()
+        file_keys = (
+            ("population", "population", int),
+            ("durationS", "duration_s", float),
+            ("rateScale", "rate_scale", float),
+            ("seed", "seed", int),
+            ("maxOutstanding", "max_outstanding", int),
+            ("reportDir", "report_dir", str),
+        )
+        env_keys = (
+            ("PIO_LOADTEST_POPULATION", "population", int),
+            ("PIO_LOADTEST_DURATION_S", "duration_s", float),
+            ("PIO_LOADTEST_RATE_SCALE", "rate_scale", float),
+            ("PIO_LOADTEST_SEED", "seed", int),
+            ("PIO_LOADTEST_OUTSTANDING", "max_outstanding", int),
+            ("PIO_LOADTEST_REPORT_DIR", "report_dir", str),
+        )
+        sources = (
+            [(k, data.get(k), attr, conv) for k, attr, conv in file_keys]
+            + [(k, os.environ.get(k), attr, conv)
+               for k, attr, conv in env_keys]
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed loadtest knob %s=%r",
+                               name, raw)
+        cfg.population = max(0, cfg.population)
+        cfg.duration_s = max(0.0, cfg.duration_s)
+        cfg.rate_scale = max(0.0, cfg.rate_scale)
+        cfg.max_outstanding = max(0, cfg.max_outstanding)
+        return cfg
+
+    def apply(self, scenario):
+        """Overlay the non-default knobs onto a Scenario in place and
+        return it (0 / negative sentinels mean "keep the scenario's
+        own value")."""
+        if self.population > 0:
+            scenario.population = self.population
+        if self.duration_s > 0:
+            scenario.duration_s = self.duration_s
+        if self.rate_scale > 0 and self.rate_scale != 1.0:
+            scenario.base_rate = scenario.base_rate * self.rate_scale
+        if self.seed >= 0:
+            scenario.seed = self.seed
+        if self.max_outstanding > 0:
+            scenario.max_outstanding = self.max_outstanding
+        return scenario
+
+
+def loadtest_config() -> LoadtestConfig:
+    """Resolve the workload-simulator knobs a ``pio loadtest`` run
+    should use: server.json ``loadtest`` section overlaid by
+    ``PIO_LOADTEST_*`` env."""
+    return LoadtestConfig.from_env(read_server_json().get("loadtest") or {})
 
 
 def read_server_json(path: Optional[str] = None) -> dict:

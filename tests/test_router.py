@@ -329,6 +329,58 @@ async def test_router_answers_503_only_when_nothing_routable():
         await _close(client, stubs)
 
 
+async def test_router_probe_backoff_for_dead_replica():
+    """A dead replica is probed at interval, 2x, 4x ... capped — NOT
+    hammered at health_interval_s forever. Over a 1.2s window at a
+    0.05s interval a non-backed-off loop would fail ~24 probes; the
+    exponential schedule (0.05 + 0.1 + 0.2 + 0.4 + 0.8 ...) fits ~5."""
+    stubs, urls = await _stubs(2)
+    router = Router(_rcfg(health_interval_s=0.05,
+                          health_backoff_cap_s=5.0), replica_urls=urls)
+    client = await _start_router(router)
+    try:
+        stubs[0].fail_probes = True
+        stubs[0].fail_queries = True
+        assert await _wait_for(lambda: not router.replicas[0].healthy)
+        before = router._health_total.value(replica="0", outcome="fail")
+        await asyncio.sleep(1.2)
+        burned = router._health_total.value(
+            replica="0", outcome="fail") - before
+        assert burned <= 8, f"{burned} probes in 1.2s is no backoff"
+        # the healthy sibling keeps its regular cadence
+        assert router.replicas[1].next_probe_at == 0.0
+    finally:
+        await _close(client, stubs)
+
+
+async def test_router_backoff_readmission_bounded_by_cap():
+    """One successful probe resets the schedule, and the cap — not the
+    downtime — bounds how stale the probe schedule can get: a replica
+    that was down long enough for uncapped backoff to reach multi-
+    second gaps must still be re-admitted within ~cap after it heals."""
+    stubs, urls = await _stubs(2)
+    router = Router(_rcfg(health_interval_s=0.05,
+                          health_backoff_cap_s=0.2), replica_urls=urls)
+    client = await _start_router(router)
+    try:
+        stubs[0].fail_probes = True
+        assert await _wait_for(lambda: not router.replicas[0].healthy)
+        # accumulate failures: uncapped, the next probe gap would be
+        # 0.05 * 2^(fails-1) >> 1s by now; the cap holds it at 0.2s
+        await asyncio.sleep(1.5)
+        assert router.replicas[0].fails >= 4
+        stubs[0].fail_probes = False
+        t0 = time.monotonic()
+        assert await _wait_for(lambda: router.replicas[0].healthy,
+                               timeout_s=5.0)
+        assert time.monotonic() - t0 <= 1.0, (
+            "re-admission took longer than the probe backoff cap allows")
+        assert router.replicas[0].fails == 0
+        assert router.replicas[0].next_probe_at == 0.0
+    finally:
+        await _close(client, stubs)
+
+
 async def test_router_drain_is_zero_drop():
     """Scale-down discipline: weight to zero FIRST, the in-flight query
     runs to completion, THEN the replica detaches."""
@@ -663,6 +715,14 @@ async def test_autoscale_e2e_zero_drops(tmp_path):
         await task
         assert statuses and set(statuses) == {200}
         assert sum(v for _, v in router._dropped.samples()) == 0
+        # active_count() flips the moment the drain STARTS (the
+        # draining flag excludes the victim); the drain coroutine —
+        # and the controller's done-event + archive — finish shortly
+        # after. Wait for both archives so the event assertions below
+        # don't race drain completion on a loaded box.
+        assert await _wait_for(
+            lambda: len(list((tmp_path / "fleet" / "history")
+                             .glob("*.json"))) == 2, timeout_s=10.0)
         # both scale decisions are flight-recorder events, one trace id
         # per action from decide to commit
         events = [e for e in recorder().events()

@@ -3136,6 +3136,334 @@ def cfg_loadtest(jax, mesh, platform):
     return detail
 
 
+def cfg_multitenant(jax, mesh, platform):
+    """Multi-tenant consolidation (server/multitenant.py): THREE engine
+    families — recommendation (ALS user->item), similarproduct
+    (item->item cosine), recommended_user (user->user follow graph) —
+    served from ONE process behind per-tenant routes, under a device
+    budget deliberately too small for all residencies at once.
+
+    Three measurements, each an acceptance gate:
+
+    * **p99 parity** — each tenant is first benched STANDALONE (its own
+      QueryServer, same scorer config), then consolidated behind the
+      MultiTenantServer gate with phased per-tenant traffic. The
+      consolidated per-tenant p99 must stay within
+      BENCH_MT_P99_SLACK (default 1.15x) of its standalone baseline —
+      the gate + shared process must not tax the hot path.
+    * **the eviction/reload cycle actually turns** — the undersized
+      budget (BENCH_MT_BUDGET_FRACTION of the scorer-backed tenants'
+      combined residency) forces warm LRU evictions at phase
+      boundaries and warm reloads on the next hit; both counters must
+      move, and every query must still answer 200.
+    * **consolidation saves bytes** — post-run device-resident bytes
+      across the host stay under the budget, which is itself under the
+      sum of the standalone residencies (the whole point of
+      consolidating).
+
+    Per-tenant quantized residency rides along: the rec tenant serves
+    int8 factors, the sim tenant bf16, in the SAME process — the
+    per-holder scorer override the multi-tenant host stamps."""
+    import asyncio
+    import gc
+    import shutil
+    import tempfile
+
+    import predictionio_tpu.models.als as als_mod
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from predictionio_tpu.core.engine import Engine, TrainResult
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.deploy.releases import record_release
+    from predictionio_tpu.engines import (
+        recommendation as rec_mod,
+        recommended_user as ru_mod,
+        similarproduct as sp_mod,
+    )
+    from predictionio_tpu.engines.common import Item
+    from predictionio_tpu.models.als import ALSModel
+    from predictionio_tpu.server.multitenant import (
+        MultiTenantServer, TenantSpec,
+    )
+    from predictionio_tpu.server.query_server import create_query_server
+    from predictionio_tpu.storage import Model, Storage
+    from predictionio_tpu.storage.base import EngineInstance
+    from predictionio_tpu.utils.server_config import (
+        DeployConfig, MultiTenantConfig, ScorerConfig, ServingConfig,
+    )
+    from predictionio_tpu.workflow.serialization import serialize_models
+
+    n_items = int(os.environ.get("BENCH_MT_ITEMS", 20000))
+    n_users = int(os.environ.get("BENCH_MT_USERS", 400))
+    rank = int(os.environ.get("BENCH_MT_RANK", 64))
+    per_tenant = int(os.environ.get("BENCH_MT_QUERIES", 300))
+    passes = int(os.environ.get("BENCH_MT_PASSES", 2))
+    slack = float(os.environ.get("BENCH_MT_P99_SLACK", 1.15))
+    budget_fraction = float(
+        os.environ.get("BENCH_MT_BUDGET_FRACTION", 0.8))
+
+    rng = np.random.default_rng(23)
+    serving_cfg = ServingConfig(batch_max=32, batch_linger_s=0.0)
+    deploy_cfg = DeployConfig(warmup=False, drain_timeout_s=10.0)
+
+    # -- three engine families, one synthetic catalog each ----------------
+    rec_model = ALSModel(
+        user_vocab=np.sort(np.asarray(
+            [f"u{i:06d}" for i in range(n_users)], dtype=object)),
+        item_vocab=np.sort(np.asarray(
+            [f"i{i:06d}" for i in range(n_items)], dtype=object)),
+        U=rng.normal(size=(n_users, rank)).astype(np.float32),
+        V=rng.normal(size=(n_items, rank)).astype(np.float32))
+
+    sp_V = rng.normal(size=(n_items, rank)).astype(np.float32)
+    sp_V /= np.linalg.norm(sp_V, axis=1, keepdims=True)
+    sp_model = sp_mod.SimilarityModel(
+        item_vocab=np.sort(np.asarray(
+            [f"i{i:06d}" for i in range(n_items)], dtype=object)),
+        V=sp_V, items={i: Item(categories=None) for i in range(n_items)})
+
+    # the follow graph gets catalog-scale factors too — every tenant's
+    # steady state must be compute-bound, or p99 parity just measures
+    # shared-process jitter against a sub-ms baseline
+    ru_V = rng.normal(size=(n_items, rank)).astype(np.float32)
+    ru_V /= np.linalg.norm(ru_V, axis=1, keepdims=True)
+    ru_model = ru_mod.RecommendedUserModel(
+        user_vocab=np.sort(np.asarray(
+            [f"u{i:06d}" for i in range(n_items)], dtype=object)),
+        V=ru_V, users={})
+
+    tenants = [
+        # (name, family, engine, model, algorithms, serving, scorer, query)
+        ("rec", "recommendation",
+         Engine(rec_mod.RecommendationDataSource,
+                rec_mod.RecommendationPreparator,
+                {"als": rec_mod.ALSAlgorithm},
+                rec_mod.RecommendationServing),
+         rec_model,
+         [rec_mod.ALSAlgorithm(rec_mod.AlgorithmParams(rank=rank))],
+         rec_mod.RecommendationServing(),
+         ScorerConfig(mode="fused_int8"),
+         lambda i: {"user": f"u{i % n_users:06d}", "num": 10}),
+        ("sim", "similarproduct",
+         Engine(sp_mod.SimilarProductDataSource,
+                sp_mod.SimilarProductPreparator,
+                {"als": sp_mod.ALSAlgorithm},
+                sp_mod.SimilarProductServing),
+         sp_model,
+         [sp_mod.ALSAlgorithm()],
+         sp_mod.SimilarProductServing(),
+         ScorerConfig(mode="fused_bf16"),
+         lambda i: {"items": [f"i{i % n_items:06d}"], "num": 10}),
+        ("social", "recommended_user",
+         Engine(ru_mod.RecommendedUserDataSource,
+                ru_mod.RecommendedUserPreparator,
+                {"als": ru_mod.ALSAlgorithm},
+                ru_mod.RecommendedUserServing),
+         ru_model,
+         [ru_mod.ALSAlgorithm()],
+         ru_mod.RecommendedUserServing(),
+         None,
+         lambda i: {"users": [f"u{i % n_items:06d}"], "num": 10}),
+    ]
+
+    root = tempfile.mkdtemp(prefix="pio_bench_mt_")
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite",
+                           "PATH": os.path.join(root, "mt.db")}},
+        "repositories": {
+            "METADATA": {"SOURCE": "DB", "NAMESPACE": "pio_meta"},
+            "MODELDATA": {"SOURCE": "DB", "NAMESPACE": "pio_model"},
+            "EVENTDATA": {"SOURCE": "DB", "NAMESPACE": "pio_event"},
+        }})
+    old_rt = als_mod._DEVICE_ROUNDTRIP_S
+    als_mod._DEVICE_ROUNDTRIP_S = 0.0   # force the device scorer lane
+    detail = {"tenants": [t[0] for t in tenants],
+              "families": [t[1] for t in tenants],
+              "items": n_items, "rank": rank,
+              "queries_per_tenant": per_tenant, "p99_slack": slack}
+    t_start = time.perf_counter()
+
+    def build_spec(name, engine, model, algorithms, serving, scorer):
+        instance = EngineInstance(
+            id=f"bench-mt-{name}", status="COMPLETED",
+            engine_id="bench-multitenant", engine_version="1",
+            engine_variant=name,
+            data_source_params=json.dumps({"app_name": f"{name}App"}),
+            algorithms_params=json.dumps(
+                [{"name": "als", "params": {"rank": rank}}]))
+        Storage.get_meta_data_engine_instances().insert(instance)
+        blob = serialize_models([model])
+        Storage.get_model_data_models().insert(
+            Model(id=instance.id, models=blob))
+        release = record_release(instance, train_seconds=0.0, blob=blob)
+        result = TrainResult(models=[model], algorithms=algorithms,
+                             serving=serving,
+                             engine_params=EngineParams())
+        return TenantSpec(name=name, engine=engine, train_result=result,
+                          instance=instance, ctx=None, release=release,
+                          scorer_config=scorer,
+                          serving_config=serving_cfg,
+                          deploy_config=deploy_cfg)
+
+    async def drive(client, path, mk_query, n, lat=None, base=0):
+        for i in range(n):
+            t0 = time.perf_counter()
+            resp = await client.post(path, json=mk_query(base + i))
+            assert resp.status == 200, (path, resp.status,
+                                        await resp.text())
+            await resp.json()
+            if lat is not None:
+                lat.append(time.perf_counter() - t0)
+
+    def p99_ms(lat):
+        return round(float(np.percentile(np.asarray(lat) * 1e3, 99)), 3)
+
+    def p50_ms(lat):
+        return round(float(np.percentile(np.asarray(lat) * 1e3, 50)), 3)
+
+    try:
+        specs = {t[0]: build_spec(t[0], t[2], t[3], t[4], t[5], t[6])
+                 for t in tenants}
+
+        # -- standalone baselines: one tenant, one process-worth ----------
+        baseline_p99 = {}
+        baseline_p50 = {}
+        standalone_bytes = {}
+
+        async def run_baseline(name, spec, mk_query):
+            server = create_query_server(
+                spec.engine, spec.train_result, spec.instance, None,
+                serving_config=serving_cfg, deploy_config=deploy_cfg,
+                scorer_config=spec.scorer_config, release=spec.release)
+            c = TestClient(TestServer(server.app))
+            await c.start_server()
+            try:
+                await drive(c, "/queries.json", mk_query, 32)  # warm/compile
+                lat = []
+                gc.collect()
+                gc.disable()   # GC pauses scale with heap size, not with
+                try:           # serving cost; keep them out of both tails
+                    await drive(c, "/queries.json", mk_query, per_tenant,
+                                lat=lat, base=32)
+                finally:
+                    gc.enable()
+                baseline_p99[name] = p99_ms(lat)
+                baseline_p50[name] = p50_ms(lat)
+                standalone_bytes[name] = server.warm_bytes
+            finally:
+                await c.close()
+
+        for name, _family, _eng, _model, _algos, _srv, _cfg, mk_q in tenants:
+            hb(f"multitenant baseline {name}")
+            asyncio.run(run_baseline(name, specs[name], mk_q))
+        detail["baseline_p99_ms"] = dict(baseline_p99)
+        detail["baseline_p50_ms"] = dict(baseline_p50)
+        detail["standalone_resident_bytes"] = dict(standalone_bytes)
+        standalone_total = sum(standalone_bytes.values())
+        assert standalone_total > 0, standalone_bytes
+
+        # -- consolidated host under an undersized budget -----------------
+        # sized so the scorer-backed tenants cannot all stay resident:
+        # phase transitions MUST evict and the next hit MUST warm-reload
+        budget = int(budget_fraction * standalone_total)
+        detail["budget_bytes"] = budget
+        mt_p99 = {}
+        mt_p50 = {}
+
+        async def run_consolidated():
+            host = MultiTenantServer(
+                list(specs.values()),
+                config=MultiTenantConfig(
+                    budget_bytes=budget, reload_wait_s=30.0,
+                    sweep_interval_s=3600.0, min_resident=1,
+                    admission=False))
+            c = TestClient(TestServer(host.app))
+            await c.start_server()
+            try:
+                lat = {t[0]: [] for t in tenants}
+                for p in range(passes):
+                    for (name, _f, _e, _m, _a, _s, _cfg, mk_q) in tenants:
+                        hb(f"multitenant pass {p} {name}")
+                        # untimed warm leg, symmetric with the baseline
+                        # methodology: the FIRST query here is the miss
+                        # that drives the warm reload, so the reload +
+                        # scorer-cache rebuild cost stays out of the
+                        # steady-state parity sample (it is proven
+                        # separately by the eviction/reload counters)
+                        await drive(c, f"/t/{name}/queries.json", mk_q,
+                                    16, base=100_000 + p * 16)
+                        gc.collect()
+                        gc.disable()
+                        try:
+                            await drive(c, f"/t/{name}/queries.json",
+                                        mk_q, per_tenant, lat=lat[name],
+                                        base=p * per_tenant)
+                        finally:
+                            gc.enable()
+                        # deterministic sweep tick: all tenants START
+                        # resident, so without this only the (disabled)
+                        # background sweep would ever notice the budget
+                        await host.enforce_budget()
+                for name, samples in lat.items():
+                    mt_p99[name] = p99_ms(samples)
+                    mt_p50[name] = p50_ms(samples)
+                # one registry serves every tenant: read the shared
+                # counters ONCE (summing per tenant would triple-count)
+                any_server = next(iter(host.tenants.values())).server
+                evictions = any_server._evict_total.value(reason="budget")
+                reloads = any_server._reload_total.value(
+                    status="warm_reload")
+                return {
+                    "evictions": int(evictions),
+                    "warm_reloads": int(reloads),
+                    "resident_bytes_end": int(host.resident_bytes()),
+                    "resident_tenants_end": sorted(
+                        t.name for t in host.tenants.values()
+                        if t.server.resident),
+                }
+            finally:
+                await c.close()
+
+        consolidated = asyncio.run(run_consolidated())
+        detail.update(consolidated)
+        detail["consolidated_p99_ms"] = dict(mt_p99)
+        detail["consolidated_p50_ms"] = dict(mt_p50)
+
+        # gate 1: the cycle actually turned under the undersized budget
+        assert consolidated["evictions"] > 0, consolidated
+        assert consolidated["warm_reloads"] > 0, consolidated
+        # gate 2: consolidation saves bytes — end-state residency under
+        # the budget, which is under the sum of standalone residencies
+        assert consolidated["resident_bytes_end"] <= budget < \
+            standalone_total, (consolidated, budget, standalone_total)
+        # gate 3: steady-state p99 parity per tenant
+        for name, base in baseline_p99.items():
+            assert mt_p99[name] <= base * slack, (
+                name, mt_p99[name], base, slack)
+
+        detail.update({
+            "elapsed_s": round(time.perf_counter() - t_start, 2),
+            "baseline_s": None,
+            "speedup_headline": round(
+                standalone_total / max(1, consolidated[
+                    "resident_bytes_end"]), 2),
+            "note": (
+                f"3 engine families consolidated: budget {budget}B vs "
+                f"{standalone_total}B standalone "
+                f"({consolidated['evictions']} evictions, "
+                f"{consolidated['warm_reloads']} warm reloads); "
+                f"per-tenant p99 consolidated/standalone: "
+                + ", ".join(
+                    f"{n} {mt_p99[n]:.1f}/{baseline_p99[n]:.1f}ms"
+                    for n in baseline_p99)),
+        })
+        return detail
+    finally:
+        als_mod._DEVICE_ROUNDTRIP_S = old_rt
+        Storage.reset()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def cfg_sleep_forever(jax, mesh, platform):
     """Test-only config (never in the default set): wedges the worker so
     the orchestrator's watchdog + ladder can be exercised on CPU."""
@@ -3163,6 +3491,7 @@ CONFIGS = {
     "topk_scoring": (cfg_topk_scoring, 240),
     "fleet_scaling": (cfg_fleet_scaling, 300),
     "loadtest": (cfg_loadtest, 420),
+    "multitenant": (cfg_multitenant, 420),
     "als_ml20m": (cfg_als_ml20m, 900),
 }
 

@@ -121,6 +121,16 @@ KNOB_OWNERS: Dict[str, Tuple[str, ...]] = {
     "PIO_LOADTEST_SEED": (SERVER_CONFIG_PATH,),
     "PIO_LOADTEST_OUTSTANDING": (SERVER_CONFIG_PATH,),
     "PIO_LOADTEST_REPORT_DIR": (SERVER_CONFIG_PATH,),
+    # multi-tenant host knob chain (env > server.json "multitenant") —
+    # resolved by MultiTenantConfig in server_config; the residency
+    # budget, warm-reload wait, LRU sweep, and admission gate
+    "PIO_MT_DEVICE_BUDGET_BYTES": (SERVER_CONFIG_PATH,),
+    "PIO_MT_RELOAD_WAIT_S": (SERVER_CONFIG_PATH,),
+    "PIO_MT_SWEEP_INTERVAL_S": (SERVER_CONFIG_PATH,),
+    "PIO_MT_MIN_RESIDENT": (SERVER_CONFIG_PATH,),
+    "PIO_MT_ADMISSION": (SERVER_CONFIG_PATH,),
+    "PIO_MT_RETRY_AFTER_S": (SERVER_CONFIG_PATH,),
+    "PIO_MT_MAX_TENANT_SERIES": (SERVER_CONFIG_PATH,),
 }
 
 #: knob *families* read via pattern scan (no literal name per knob) —

@@ -551,6 +551,63 @@ def deploy(variant, ip, port, engine_instance_id, release_selector, feedback,
 
 
 @cli.command()
+@click.option("--tenant", "-t", "tenant_specs", multiple=True, required=True,
+              help="NAME=VARIANT_PATH, repeatable: co-host the latest "
+                   "COMPLETED instance of each variant as tenant NAME.")
+@click.option("--ip", default="localhost")
+@click.option("--port", default=8800, type=int)
+@click.option("--accesskey", default=None,
+              help="Key guarding every tenant's /stop, /reload and "
+                   "deploy API.")
+def multiserve(tenant_specs, ip, port, accesskey):
+    """Serve N engine variants from ONE process under one device-memory
+    budget (server/multitenant.py): per-tenant routes at
+    /t/NAME/queries.json, LRU warm eviction/reload under
+    PIO_MT_DEVICE_BUDGET_BYTES, per-tenant int8/bf16 scorer residency,
+    and SLO-burn admission control."""
+    from predictionio_tpu.server.multitenant import (
+        TenantSpec, run_multitenant_server,
+    )
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.utils.server_config import (
+        foldin_config, scorer_config,
+    )
+    from predictionio_tpu.workflow.train import load_for_deploy
+
+    specs = []
+    instances = Storage.get_meta_data_engine_instances()
+    for entry in tenant_specs:
+        name, sep, variant_path = entry.partition("=")
+        if not sep or not name or not variant_path:
+            click.echo(f"[ERROR] --tenant wants NAME=VARIANT_PATH, got "
+                       f"{entry!r}. Aborting.")
+            sys.exit(1)
+        engine, _, factory_path, variant_id, _vj = \
+            _load_engine_variant(variant_path)
+        instance = instances.get_latest_completed(
+            factory_path, "1", variant_id)
+        if instance is None:
+            click.echo(f"[ERROR] Tenant {name!r}: no COMPLETED engine "
+                       f"instance for {variant_path}. Run `pio train` "
+                       "first. Aborting.")
+            sys.exit(1)
+        release = _release_of_instance(factory_path, variant_id, instance.id)
+        scfg = scorer_config((_vj or {}).get("scorer"))
+        result, ctx = load_for_deploy(engine, instance)
+        click.echo(f"[INFO] Tenant {name!r}: instance {instance.id}"
+                   + (f" (release v{release.version})" if release else "")
+                   + f", scorer {scfg.mode}")
+        specs.append(TenantSpec(
+            name=name, engine=engine, train_result=result,
+            instance=instance, ctx=ctx, release=release,
+            scorer_config=scfg,
+            foldin_config=foldin_config((_vj or {}).get("foldin")),
+            slo=(_vj or {}).get("slo")))
+    click.echo(f"[INFO] Hosting {len(specs)} tenant(s) at {ip}:{port}")
+    run_multitenant_server(specs, ip=ip, port=port, access_key=accesskey)
+
+
+@cli.command()
 @click.option("--variant", "-v", default="engine.json")
 @click.option("--ip", default="localhost")
 @click.option("--port", default=None, type=int,
@@ -655,9 +712,9 @@ def loadtest(scenario_path, show_example, workdir, report_path, as_json):
         click.echo(f"[ERROR] bad scenario: {e}")
         sys.exit(1)
 
-    from predictionio_tpu.loadtest.fleet import LocalFleet
+    from predictionio_tpu.loadtest.fleet import LocalFleet, MultiTenantFleet
     from predictionio_tpu.loadtest.simulator import (
-        run_storm, storm_report_json,
+        run_storm, run_tenant_storm, storm_report_json,
     )
     from predictionio_tpu.utils.server_config import loadtest_config
 
@@ -668,17 +725,35 @@ def loadtest(scenario_path, show_example, workdir, report_path, as_json):
     if workdir is None:
         tmp = tempfile.TemporaryDirectory(prefix="pio-loadtest-")
         workdir = tmp.name
-    click.echo(f"[INFO] Storm '{sc.name}': population={sc.population} "
-               f"duration={sc.duration_s:g}s rate={sc.base_rate:g}/s "
-               f"replicas={sc.replicas} partitions={sc.partitions} "
-               f"backend={sc.backend} incidents={len(sc.incidents)}")
-    fleet = LocalFleet(workdir, replicas=sc.replicas,
-                       partitions=sc.partitions, backend=sc.backend)
     try:
-        fleet.start()
-        report = run_storm(sc, fleet)
+        if sc.tenants:
+            click.echo(
+                f"[INFO] Multi-tenant storm '{sc.name}': "
+                f"{len(sc.tenants)} tenant(s) "
+                f"[{', '.join(t.name for t in sc.tenants)}] "
+                f"duration={sc.duration_s:g}s rate={sc.base_rate:g}/s "
+                f"incidents={len(sc.incidents)}")
+            fleet = MultiTenantFleet(workdir, sc.tenants)
+            try:
+                fleet.start()
+                report = run_tenant_storm(sc, fleet)
+            finally:
+                fleet.stop()
+        else:
+            click.echo(
+                f"[INFO] Storm '{sc.name}': population={sc.population} "
+                f"duration={sc.duration_s:g}s rate={sc.base_rate:g}/s "
+                f"replicas={sc.replicas} partitions={sc.partitions} "
+                f"backend={sc.backend} incidents={len(sc.incidents)}")
+            fleet = LocalFleet(workdir, replicas=sc.replicas,
+                               partitions=sc.partitions,
+                               backend=sc.backend)
+            try:
+                fleet.start()
+                report = run_storm(sc, fleet)
+            finally:
+                fleet.stop()
     finally:
-        fleet.stop()
         if tmp is not None:
             tmp.cleanup()
 
@@ -695,10 +770,16 @@ def loadtest(scenario_path, show_example, workdir, report_path, as_json):
     if as_json:
         click.echo(storm_report_json(report))
     else:
-        for lane, res in sorted(report["lanes"].items()):
+        for lane, res in sorted(report.get("lanes", {}).items()):
             click.echo(
                 f"[INFO] lane {lane}: offered={res['offered']} "
                 f"acked={res['acked']} failed={res['failed']} "
+                f"p99={res['ack_p99_ms']:.1f}ms")
+        for name, res in sorted(report.get("tenants", {}).items()):
+            click.echo(
+                f"[INFO] tenant {name}: offered={res['offered']} "
+                f"acked={res['acked']} failed={res['failed']} "
+                f"rejected={res['rejections']} "
                 f"p99={res['ack_p99_ms']:.1f}ms")
         for inv in report["invariants"]:
             mark = "ok " if inv["ok"] else "FAIL"
@@ -707,8 +788,11 @@ def loadtest(scenario_path, show_example, workdir, report_path, as_json):
     if not report["ok"]:
         click.echo("[ERROR] storm verdict: INVARIANT VIOLATED")
         sys.exit(1)
+    arrivals = report.get(
+        "arrivals",
+        sum(r["offered"] for r in report.get("tenants", {}).values()))
     click.echo(f"[INFO] storm verdict: OK "
-               f"({report['arrivals']} arrivals, "
+               f"({arrivals} arrivals, "
                f"{report['wall_s']:.1f}s wall)")
 
 

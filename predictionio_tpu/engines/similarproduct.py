@@ -317,7 +317,7 @@ class ALSAlgorithm(Algorithm):
         including its stop-at-nonpositive-score rule."""
         from predictionio_tpu.ops import scoring
 
-        if scoring.process_scorer_config().mode == "exact":
+        if scoring.holder_scorer_config(model).mode == "exact":
             return None
         extra = 0
         want_max = 0

@@ -23,5 +23,5 @@ from predictionio_tpu.loadtest.population import (  # noqa: F401
     Population, ZipfSampler, arrival_offsets, diurnal_rate,
 )
 from predictionio_tpu.loadtest.scenario import (  # noqa: F401
-    Incident, Scenario, ScenarioError,
+    Incident, Scenario, ScenarioError, TenantMix,
 )

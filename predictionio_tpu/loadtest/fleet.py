@@ -44,7 +44,7 @@ from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["LocalFleet"]
+__all__ = ["LocalFleet", "MultiTenantFleet"]
 
 #: events the batch endpoint accepts per request (the fleet raises the
 #: reference's 50 cap for bulk emitters — one knob, disclosed in detail)
@@ -586,3 +586,249 @@ class LocalFleet:
             if q is not None:
                 best.append(float(q))
         return max(best) if best else None
+
+
+class MultiTenantFleet:
+    """A consolidated multi-tenant host under storm: ONE
+    :class:`~predictionio_tpu.server.multitenant.MultiTenantServer`
+    process serving every scenario tenant behind ``/t/{name}/``, each
+    tenant trained on its own tiny synthetic ALS catalog sized from its
+    :class:`~predictionio_tpu.loadtest.scenario.TenantMix`.
+
+    The surface ``run_tenant_storm`` drives:
+
+    * ``submit_tenant_query(name, payload)`` — a Future resolving to the
+      parsed body (raises on non-200, so gate 429s land as lane
+      failures — visible, not silent);
+    * ``burn_tenant(name, duration_s)`` — malformed queries at ONE
+      tenant's gate route until its errors budget burns (the incident
+      lever for ``burn_slo`` + ``tenant``);
+    * ``tenant_rejections(name)`` — host-side 429 count, the proof the
+      shed came from admission control rather than tenant errors.
+
+    Every tenant gets an errors SLO so the burn has a budget to burn;
+    admission is ON — that is the subsystem under test.
+    """
+
+    def __init__(self, root: str, tenants, *, budget_bytes: int = 0,
+                 error_budget: float = 0.05,
+                 manage_storage: bool = True):
+        self.root = str(root)
+        self.mixes = list(tenants)
+        self.budget_bytes = int(budget_bytes)
+        self.error_budget = float(error_budget)
+        self.manage_storage = manage_storage
+        self.base_url: Optional[str] = None
+        self.host = None                   #: the MultiTenantServer
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._session = None
+        self._runner = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        from aiohttp import web
+
+        from predictionio_tpu.server.multitenant import MultiTenantServer
+        from predictionio_tpu.utils.server_config import MultiTenantConfig
+
+        os.makedirs(self.root, exist_ok=True)
+        if self.manage_storage:
+            self._configure_storage()
+        specs = [self._build_spec(i, mix)
+                 for i, mix in enumerate(self.mixes)]
+        self.host = MultiTenantServer(
+            specs,
+            config=MultiTenantConfig(
+                budget_bytes=self.budget_bytes, reload_wait_s=10.0,
+                sweep_interval_s=0.5, min_resident=1, admission=True,
+                retry_after_s=0.5))
+        self._start_loop()
+        port = _free_port()
+
+        async def _up():
+            runner = web.AppRunner(self.host.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            return runner
+
+        self._runner = self._run(_up(), timeout=60)
+        self.base_url = f"http://127.0.0.1:{port}"
+
+    def stop(self) -> None:
+        try:
+            if self._loop is not None:
+                self._run(self._shutdown(), timeout=30)
+        except Exception:
+            logger.exception("multi-tenant fleet shutdown raised")
+        finally:
+            if self._loop is not None:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+                if self._loop_thread is not None:
+                    self._loop_thread.join(10)
+                self._loop.close()
+                self._loop = None
+            if self.manage_storage:
+                from predictionio_tpu.storage import Storage
+
+                Storage.reset()
+
+    async def _shutdown(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- construction --------------------------------------------------------
+    def _configure_storage(self) -> None:
+        from predictionio_tpu.storage import Storage
+
+        Storage.configure({
+            "sources": {"DB": {"TYPE": "sqlite",
+                               "PATH": os.path.join(self.root, "mt.db")}},
+            "repositories": {
+                "METADATA": {"SOURCE": "DB", "NAMESPACE": "pio_meta"},
+                "MODELDATA": {"SOURCE": "DB", "NAMESPACE": "pio_model"},
+                "EVENTDATA": {"SOURCE": "DB", "NAMESPACE": "pio_event"},
+            }})
+
+    def _build_spec(self, idx: int, mix):
+        """One reloadable tenant: synthetic ALS factors over the mix's
+        catalog, persisted (instance + blob + release) so the host's
+        warm eviction/reload cycle has a real ladder to climb."""
+        import numpy as np
+
+        from predictionio_tpu.core.engine import Engine, TrainResult
+        from predictionio_tpu.core.params import EngineParams
+        from predictionio_tpu.deploy.releases import record_release
+        from predictionio_tpu.engines.recommendation import (
+            ALSAlgorithm, AlgorithmParams, DataSourceParams,
+            RecommendationDataSource, RecommendationPreparator,
+            RecommendationServing,
+        )
+        from predictionio_tpu.models.als import ALSModel
+        from predictionio_tpu.storage import Model, Storage
+        from predictionio_tpu.storage.base import EngineInstance
+        from predictionio_tpu.server.multitenant import TenantSpec
+        from predictionio_tpu.utils.server_config import (
+            DeployConfig, ServingConfig,
+        )
+        from predictionio_tpu.workflow.serialization import serialize_models
+
+        rank = 8
+        n_users = min(int(mix.population), 64)
+        n_items = int(mix.items)
+        rng = np.random.default_rng(1000 + idx)
+        model = ALSModel(
+            user_vocab=np.sort(np.asarray(
+                [f"u{i}" for i in range(n_users)], dtype=object)),
+            item_vocab=np.sort(np.asarray(
+                [f"i{i}" for i in range(n_items)], dtype=object)),
+            U=rng.normal(size=(n_users, rank)).astype(np.float32),
+            V=rng.normal(size=(n_items, rank)).astype(np.float32))
+        instance = EngineInstance(
+            id=f"mtfleet-{mix.name}", status="COMPLETED",
+            engine_id="loadtest-multitenant", engine_version="1",
+            engine_variant=mix.name,
+            data_source_params=json.dumps({"app_name": f"{mix.name}App"}),
+            algorithms_params=json.dumps(
+                [{"name": "als", "params": {"rank": rank}}]))
+        Storage.get_meta_data_engine_instances().insert(instance)
+        blob = serialize_models([model])
+        Storage.get_model_data_models().insert(
+            Model(id=instance.id, models=blob))
+        release = record_release(instance, train_seconds=0.0, blob=blob)
+        result = TrainResult(
+            models=[model],
+            algorithms=[ALSAlgorithm(AlgorithmParams(rank=rank))],
+            serving=RecommendationServing(),
+            engine_params=EngineParams(
+                data_source_params=DataSourceParams(
+                    app_name=f"{mix.name}App")))
+        engine = Engine(
+            data_source_classes=RecommendationDataSource,
+            preparator_classes=RecommendationPreparator,
+            algorithm_classes={"als": ALSAlgorithm},
+            serving_classes=RecommendationServing)
+        return TenantSpec(
+            name=mix.name, engine=engine, train_result=result,
+            instance=instance, ctx=None, release=release,
+            serving_config=ServingConfig(batch_max=16,
+                                         batch_linger_s=0.0),
+            deploy_config=DeployConfig(warmup=False,
+                                       drain_timeout_s=5.0),
+            slo={"objectives": [
+                    {"name": "errors", "kind": "errors",
+                     "budget": self.error_budget}],
+                 "windows": [{"seconds": 60, "burnThreshold": 1.0}],
+                 "evalIntervalS": 0.25})
+
+    # -- loop plumbing (one background loop, same as LocalFleet) -------------
+    def _start_loop(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        ready = threading.Event()
+
+        def _spin():
+            asyncio.set_event_loop(self._loop)
+            ready.set()
+            self._loop.run_forever()
+
+        self._loop_thread = threading.Thread(
+            target=_spin, name="mt-fleet-loop", daemon=True)
+        self._loop_thread.start()
+        ready.wait(10)
+
+        async def _mk_session():
+            import aiohttp
+
+            return aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60))
+
+        self._session = self._run(_mk_session(), timeout=10)
+
+    def _run(self, coro, timeout: float = 60.0):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._loop).result(timeout)
+
+    def _submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    # -- the storm surface ---------------------------------------------------
+    def submit_tenant_query(self, tenant: str, payload: dict):
+        return self._submit(self._post_tenant_query(tenant, payload))
+
+    async def _post_tenant_query(self, tenant: str, payload: dict) -> dict:
+        url = f"{self.base_url}/t/{tenant}/queries.json"
+        async with self._session.post(url, json=payload) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"tenant query HTTP {resp.status}: {body}")
+            return body
+
+    def burn_tenant(self, tenant: str, duration_s: float) -> None:
+        """Burn ONE tenant's error budget: malformed queries at its
+        gate route answer 400 (counted as tenant failures) until
+        admission flips to 429 — then keep pressing so the burn holds
+        for the window."""
+        import urllib.request
+
+        deadline = time.monotonic() + duration_s
+        url = f"{self.base_url}/t/{tenant}/queries.json"
+        while time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(
+                    url, data=b"{not json", method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=2) as r:
+                    r.read()
+            except Exception:
+                pass   # 400s/429s are the point
+            time.sleep(0.02)
+
+    def tenant_rejections(self, tenant: str) -> int:
+        return int(self.host._rejected.value(tenant=tenant))
+
+    def tenant_resident(self, tenant: str) -> bool:
+        return self.host.tenants[tenant].server.resident

@@ -32,7 +32,7 @@ import dataclasses
 import json
 from typing import List, Optional
 
-__all__ = ["ScenarioError", "Incident", "Scenario"]
+__all__ = ["ScenarioError", "Incident", "TenantMix", "Scenario"]
 
 INCIDENT_KINDS = ("kill_replica", "kill_compaction", "burn_slo",
                   "degrade_quality", "retrain")
@@ -82,8 +82,10 @@ class Incident:
     target: int = 0
     restart_after_s: float = 0.0
     duration_s: float = 0.0
+    tenant: str = ""                  #: burn_slo only: burn ONE tenant
 
-    _ALLOWED = {"kind", "atS", "target", "restartAfterS", "durationS"}
+    _ALLOWED = {"kind", "atS", "target", "restartAfterS", "durationS",
+                "tenant"}
 
     @classmethod
     def from_dict(cls, d: dict, path: str, duration_s: float) -> "Incident":
@@ -97,16 +99,23 @@ class Incident:
         _expect(at_s <= duration_s, f"{path}.atS",
                 f"incident at {at_s}s is past the scenario's "
                 f"{duration_s}s duration")
+        tenant = d.get("tenant", "")
+        _expect(isinstance(tenant, str), f"{path}.tenant",
+                f"expected a string, got {tenant!r}")
         inc = cls(
             kind=kind, at_s=float(at_s),
             target=_int(d, "target", path, default=0, lo=0),
             restart_after_s=float(
                 _num(d, "restartAfterS", path, default=0.0, lo=0.0)),
             duration_s=float(
-                _num(d, "durationS", path, default=0.0, lo=0.0)))
+                _num(d, "durationS", path, default=0.0, lo=0.0)),
+            tenant=tenant)
         if kind != "kill_replica":
             _expect("restartAfterS" not in d, f"{path}.restartAfterS",
                     f"only kill_replica incidents restart, not {kind}")
+        if kind != "burn_slo":
+            _expect("tenant" not in d, f"{path}.tenant",
+                    f"only burn_slo incidents target a tenant, not {kind}")
         return inc
 
     def to_dict(self) -> dict:
@@ -117,7 +126,48 @@ class Incident:
             d["restartAfterS"] = self.restart_after_s
         if self.duration_s:
             d["durationS"] = self.duration_s
+        if self.tenant:
+            d["tenant"] = self.tenant
         return d
+
+
+@dataclasses.dataclass
+class TenantMix:
+    """One tenant's slice of a multi-tenant storm: its OWN Zipf
+    population/catalog and a rate scale relative to the scenario's
+    ``baseRate`` — independent skews are the point (one tenant's head
+    items must not warm another's cache)."""
+
+    name: str
+    population: int = 1_000
+    items: int = 200
+    rate_scale: float = 1.0
+    item_alpha: float = 1.1
+
+    _ALLOWED = {"name", "population", "items", "rateScale", "itemAlpha"}
+
+    @classmethod
+    def from_dict(cls, d: dict, path: str) -> "TenantMix":
+        _expect(isinstance(d, dict), path, f"expected an object, got {d!r}")
+        _reject_unknown(d, cls._ALLOWED, path)
+        name = d.get("name")
+        _expect(isinstance(name, str) and bool(name)
+                and "/" not in name and " " not in name,
+                f"{path}.name",
+                f"expected a non-empty URL-safe string, got {name!r}")
+        return cls(
+            name=name,
+            population=_int(d, "population", path, default=1_000, lo=1),
+            items=_int(d, "items", path, default=200, lo=1),
+            rate_scale=float(_num(d, "rateScale", path, default=1.0,
+                                  lo=0.001)),
+            item_alpha=float(_num(d, "itemAlpha", path, default=1.1,
+                                  lo=0.0)))
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "population": self.population,
+                "items": self.items, "rateScale": self.rate_scale,
+                "itemAlpha": self.item_alpha}
 
 
 @dataclasses.dataclass
@@ -141,10 +191,12 @@ class Scenario:
     backend: str = "sqlite"
     max_outstanding: int = 256
     incidents: List[Incident] = dataclasses.field(default_factory=list)
+    tenants: List[TenantMix] = dataclasses.field(default_factory=list)
 
     _ALLOWED = {"name", "population", "items", "durationS", "seed",
                 "baseRate", "amplitude", "periodS", "mix", "replicas",
-                "partitions", "backend", "maxOutstanding", "incidents"}
+                "partitions", "backend", "maxOutstanding", "incidents",
+                "tenants"}
 
     @classmethod
     def from_dict(cls, d: dict) -> "Scenario":
@@ -173,6 +225,14 @@ class Scenario:
             Incident.from_dict(item, f"$.incidents[{i}]", duration_s)
             for i, item in enumerate(incidents_raw)]
         incidents.sort(key=lambda inc: inc.at_s)
+        tenants_raw = d.get("tenants", [])
+        _expect(isinstance(tenants_raw, list), "$.tenants",
+                f"expected an array, got {tenants_raw!r}")
+        tenants = [TenantMix.from_dict(item, f"$.tenants[{i}]")
+                   for i, item in enumerate(tenants_raw)]
+        tenant_names = {t.name for t in tenants}
+        _expect(len(tenant_names) == len(tenants), "$.tenants",
+                "tenant names must be unique")
         sc = cls(
             name=name,
             population=_int(d, "population", "$", default=10_000, lo=1),
@@ -191,13 +251,19 @@ class Scenario:
             backend=backend,
             max_outstanding=_int(d, "maxOutstanding", "$", default=256,
                                  lo=1),
-            incidents=incidents)
+            incidents=incidents,
+            tenants=tenants)
         for i, inc in enumerate(incidents):
             if inc.kind == "kill_replica":
                 _expect(inc.target < sc.replicas,
                         f"$.incidents[{i}].target",
                         f"replica {inc.target} does not exist "
                         f"(fleet has {sc.replicas})")
+            if inc.tenant:
+                _expect(inc.tenant in tenant_names,
+                        f"$.incidents[{i}].tenant",
+                        f"tenant {inc.tenant!r} is not in $.tenants "
+                        f"(have {sorted(tenant_names)})")
         return sc
 
     @classmethod
@@ -227,6 +293,8 @@ class Scenario:
             "backend": self.backend,
             "maxOutstanding": self.max_outstanding,
             "incidents": [inc.to_dict() for inc in self.incidents],
+            **({"tenants": [t.to_dict() for t in self.tenants]}
+               if self.tenants else {}),
         }
 
 
@@ -250,5 +318,31 @@ def example_scenario() -> dict:
             {"kind": "kill_replica", "atS": 8.0, "target": 1,
              "restartAfterS": 6.0},
             {"kind": "retrain", "atS": 12.0},
+        ],
+    }
+
+
+def example_tenant_scenario() -> dict:
+    """A multi-tenant storm for ``pio loadtest``: three tenants with
+    independent Zipf skews behind ONE consolidated host, an incident
+    burning tenant ``beta``'s SLO mid-run — the others' p99 must
+    hold (admission sheds the burner, not its neighbours)."""
+    return {
+        "name": "example-multitenant",
+        "durationS": 12.0,
+        "seed": 7,
+        "baseRate": 40.0,
+        "amplitude": 0.3,
+        "tenants": [
+            {"name": "alpha", "population": 2_000, "items": 400,
+             "rateScale": 1.0, "itemAlpha": 1.1},
+            {"name": "beta", "population": 500, "items": 150,
+             "rateScale": 0.5, "itemAlpha": 1.4},
+            {"name": "gamma", "population": 5_000, "items": 800,
+             "rateScale": 0.25, "itemAlpha": 0.9},
+        ],
+        "incidents": [
+            {"kind": "burn_slo", "atS": 3.0, "tenant": "beta",
+             "durationS": 4.0},
         ],
     }

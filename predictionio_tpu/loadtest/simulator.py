@@ -40,7 +40,7 @@ from predictionio_tpu.obs.trace_context import record_event
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["run_storm"]
+__all__ = ["run_storm", "run_tenant_storm"]
 
 #: events coalesced per batch POST (the SDK bulk-emitter shape)
 EVENT_BATCH = 64
@@ -287,6 +287,132 @@ def run_storm(scenario: Scenario, fleet, *,
         "foldin_applied_rows": fleet.foldin_applied_rows(),
     }
     return report
+
+
+def run_tenant_storm(scenario: Scenario, fleet, *,
+                     query_p99_bound_ms: float = 2000.0,
+                     registry=None) -> dict:
+    """Drive a multi-tenant storm: one query lane PER TENANT, each with
+    its own Zipf population/catalog and rate scale, against a fleet
+    exposing ``submit_tenant_query(name, payload)`` (a started
+    :class:`MultiTenantFleet`, or any consolidated host adapter).
+
+    The only incident kind here is ``burn_slo`` with a ``tenant`` —
+    the point of the storm is the blast-radius verdict: the burned
+    tenant gets shed at the gate (429s observed as lane failures, and
+    at least one rejection counted host-side), while every OTHER
+    tenant's query p99 stays under the bound and drops nothing.
+    """
+    sc = scenario
+    if not sc.tenants:
+        raise ValueError("scenario has no tenants — use run_storm")
+    for inc in sc.incidents:
+        if inc.kind != "burn_slo":
+            raise ValueError(
+                f"tenant storms only support burn_slo incidents, "
+                f"got {inc.kind!r}")
+    burned = {inc.tenant for inc in sc.incidents if inc.tenant}
+    engine = InvariantEngine(registry)
+    m_incidents = loadtest_stats.loadtest_incidents(registry)
+    timeout_s = sc.duration_s + 120.0
+    results = {}
+    pops = {}
+    threads: List[threading.Thread] = []
+
+    for idx, mix in enumerate(sc.tenants):
+        # independent skews: each tenant gets its OWN seed lineage so
+        # one tenant's head items say nothing about another's
+        pop = Population(mix.population, mix.items,
+                         seed=sc.seed + 101 * (idx + 1),
+                         item_alpha=mix.item_alpha)
+        pops[mix.name] = pop
+        offsets = arrival_offsets(
+            sc.duration_s, sc.base_rate * mix.rate_scale, sc.amplitude,
+            sc.effective_period_s, seed=sc.seed + 13 * (idx + 1))
+        items = [(uid, pop.query_for(uid))
+                 for uid in (pop.next_user() for _ in offsets)]
+
+        def _submit(item, name=mix.name):
+            return fleet.submit_tenant_query(name, item[1])
+
+        def _on_ack(item, fut, pop=pop):
+            try:
+                scores = fut.result().get("itemScores") or []
+            except Exception:
+                return
+            pop.record_recommendations(
+                item[0],
+                [str(s.get("item")) for s in scores if s.get("item")])
+
+        def _drive(name, items, submit, schedule, on_ack):
+            results[name] = drive_open_loop(
+                items, submit, max_outstanding=sc.max_outstanding,
+                timeout_s=timeout_s, schedule=schedule, on_ack=on_ack,
+                ledger=LatencyLedger())
+
+        threads.append(threading.Thread(
+            target=_drive, name=f"storm-queries-{mix.name}",
+            args=(mix.name, items, _submit, list(offsets), _on_ack)))
+
+    burn_threads: List[threading.Thread] = []
+
+    def _incident_loop(t_start: float) -> None:
+        for incident in sc.incidents:
+            wait = t_start + incident.at_s - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            m_incidents.inc(kind=incident.kind)
+            record_event("loadtest_incident", incident.to_dict())
+            logger.info("incident @%.1fs: burn_slo tenant=%s",
+                        incident.at_s, incident.tenant or "<all>")
+            t = threading.Thread(
+                target=fleet.burn_tenant,
+                args=(incident.tenant, incident.duration_s or 2.0),
+                name=f"storm-burn-{incident.tenant or 'all'}")
+            t.start()
+            burn_threads.append(t)
+
+    t_start = time.perf_counter()
+    incident_thread = threading.Thread(
+        target=_incident_loop, args=(t_start,), name="storm-incidents")
+    incident_thread.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout_s + 30)
+    incident_thread.join(30)
+    for t in burn_threads:
+        t.join(60)
+    wall_s = time.perf_counter() - t_start
+
+    # -- the blast-radius verdict --------------------------------------------
+    for mix in sc.tenants:
+        res = results[mix.name]
+        engine.check_open_loop(f"no_dropped_queries:{mix.name}", res)
+        if mix.name in burned:
+            # the burn MUST have tripped admission: rejections counted
+            # host-side prove the 429 path, not just lane errors
+            rejected = fleet.tenant_rejections(mix.name)
+            engine.check(f"tenant_shed:{mix.name}", rejected > 0,
+                         f"admission rejections={rejected}")
+        else:
+            engine.check_latency(f"tenant_p99:{mix.name}",
+                                 res.p99_ms(), query_p99_bound_ms)
+            engine.check(
+                f"tenant_unshed:{mix.name}",
+                fleet.tenant_rejections(mix.name) == 0,
+                f"rejections={fleet.tenant_rejections(mix.name)}")
+
+    return {
+        "scenario": sc.to_dict(),
+        "ok": engine.ok,
+        "wall_s": round(wall_s, 2),
+        "tenants": {name: {**res.as_dict(),
+                           "activeUsers": pops[name].active_users,
+                           "rejections": fleet.tenant_rejections(name)}
+                    for name, res in results.items()},
+        "invariants": engine.report(),
+    }
 
 
 def _burn_slo(fleet, duration_s: float) -> None:

@@ -1199,9 +1199,9 @@ class ALSModel:
         quantized residency for a catalog scale where the host crossover
         is irrelevant, and splitting a fused deployment's traffic across
         an exact host lane would make answers depend on batch size."""
-        from predictionio_tpu.ops.scoring import process_scorer_config
+        from predictionio_tpu.ops.scoring import holder_scorer_config
 
-        cfg = process_scorer_config()
+        cfg = holder_scorer_config(self)
         if cfg.mode != "exact":
             return False
         if int(getattr(cfg, "shards", 1) or 1) > 1:

@@ -139,6 +139,16 @@ def process_scorer_config():
         return _PROCESS_CFG
 
 
+def holder_scorer_config(holder):
+    """The scorer knobs THIS holder scores under: a per-holder override
+    stamped by the multi-tenant server (``_scorer_cfg_override``) beats
+    the process pin — one process can keep tenant A's factors int8 and
+    tenant B's bf16, each tenant's residency chosen to fit the shared
+    device-memory budget."""
+    override = getattr(holder, "_scorer_cfg_override", None)
+    return override if override is not None else process_scorer_config()
+
+
 # ---------------------------------------------------------------------------
 # streaming kernels (module-level jits shared across shapes; the
 # shape_cached_fn wrappers below are the per-bucket compile ledger)
@@ -811,8 +821,10 @@ def scorer_for(holder, V: np.ndarray) -> Optional[ItemScorer]:
     warm drive) rebuilds from the updated rows. Returns ``None`` in
     unsharded exact mode (callers keep the legacy path); with
     ``shards > 1`` every mode — exact included — routes through the
-    model-parallel :class:`ShardedScorer`."""
-    cfg = process_scorer_config()
+    model-parallel :class:`ShardedScorer`. A per-holder
+    ``_scorer_cfg_override`` (multi-tenant serving) beats the process
+    pin, so co-hosted tenants can hold different quantized residencies."""
+    cfg = holder_scorer_config(holder)
     shards = int(getattr(cfg, "shards", 1) or 1)
     if cfg.mode == "exact" and shards <= 1:
         return None

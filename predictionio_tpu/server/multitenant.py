@@ -1,0 +1,478 @@
+"""Multi-tenant serving host: N ServingUnits, one process, one budget.
+
+ROADMAP item 1's scaling ceiling: production means tens of engine
+variants (six engine families x apps x canaries) sharing device memory,
+and a process+JAX-runtime per variant wastes the scarcest resource the
+TPU-native rebuild has — HBM-resident factors. This module hosts N
+full :class:`~predictionio_tpu.server.query_server.QueryServer`\\ s
+behind per-tenant routes (``POST /t/{tenant}/queries.json``) in ONE
+process, under ONE device-memory budget:
+
+* **residency budgeter** — attributes bytes per tenant from the
+  capacity ledger (``obs/capacity.py``, the PR 14 scorer
+  ``factorBytes`` roll-up), evicts the least-recently-queried tenant
+  to warm on-host state (params + registry release pointer retained,
+  factors dropped) when the budget is exceeded, and reloads through
+  the existing ``warmup_unit`` ladder on the next hit;
+* **per-tenant scorer residency** — each tenant's QueryServer is
+  built with ``pin_process_scorer=False`` and stamps ITS resolved
+  :class:`ScorerConfig` onto its model holders
+  (``ops/scoring.holder_scorer_config``), so tenant A holds int8
+  factors (3.8x under f32) while tenant B holds bf16 in the same
+  process — the eviction-avoidance lever;
+* **per-tenant isolation** — every tenant keeps its OWN MicroBatcher,
+  fold-in/canary controllers and release lineage (the registry already
+  keys on engineId/engineVersion/engineVariant), plus tenant-labelled
+  metrics and an SLO burn-rate engine;
+* **admission control** — a tenant whose SLO budget is burning is
+  429'd (with Retry-After) at the host gate, so one noisy tenant
+  cannot evict or queue-starve the rest.
+
+Knobs: ``PIO_MT_*`` / server.json ``multitenant``
+(:class:`~predictionio_tpu.utils.server_config.MultiTenantConfig`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from predictionio_tpu.obs.capacity import (
+    add_capacity_route, register_capacity_metrics,
+)
+from predictionio_tpu.obs.middleware import (
+    add_metrics_routes, observability_middleware,
+)
+from predictionio_tpu.obs.registry import MetricsRegistry, default_registry
+from predictionio_tpu.obs.slo import (
+    KIND_ERRORS, KIND_FRESHNESS, KIND_LATENCY, SLOEngine, SLOSpec,
+)
+from predictionio_tpu.server.query_server import QueryServer
+from predictionio_tpu.utils.server_config import MultiTenantConfig
+
+logger = logging.getLogger("pio.server.multitenant")
+
+DEFAULT_PORT = 8800
+
+#: tenant names become URL path segments and metric label values — keep
+#: them boring (no '/', no label-breaking characters)
+_TENANT_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
+
+@dataclasses.dataclass
+class TenantSpec:
+    """Everything needed to co-host one engine variant as a tenant.
+
+    ``scorer_config`` is the per-tenant residency choice (int8 keeps
+    ~3.8x more tenants resident than f32 before the budgeter has to
+    evict); ``slo`` is a raw server.json-style ``"slo"`` section whose
+    objective names get tenant-prefixed so N tenants share one
+    registry's ``pio_slo_*`` gauges without colliding.
+    """
+
+    name: str
+    engine: Any
+    train_result: Any
+    instance: Any
+    ctx: Any
+    release: Any = None
+    scorer_config: Any = None
+    serving_config: Any = None
+    deploy_config: Any = None
+    foldin_config: Any = None
+    slo: Optional[dict] = None
+
+
+class Tenant:
+    """One co-hosted tenant: its QueryServer plus the host-side state
+    the budgeter and admission gate need (LRU clock, SLO engine)."""
+
+    __slots__ = ("name", "server", "slo", "last_hit")
+
+    def __init__(self, name: str, server: QueryServer,
+                 slo: Optional[SLOEngine]):
+        self.name = name
+        self.server = server
+        self.slo = slo
+        self.last_hit = time.monotonic()
+
+
+class MultiTenantServer:
+    """One process, N tenants, one device-memory budget."""
+
+    def __init__(self, specs: List[TenantSpec],
+                 config: Optional[MultiTenantConfig] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 access_key: Optional[str] = None,
+                 telemetry=None):
+        if not specs:
+            raise ValueError("multi-tenant host needs at least one tenant")
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names: {sorted(names)}")
+        for name in names:
+            if not _TENANT_NAME_RE.match(name):
+                raise ValueError(
+                    f"tenant name {name!r} is not URL/label safe "
+                    f"(want {_TENANT_NAME_RE.pattern})")
+        self.config = config or MultiTenantConfig.from_env()
+        self.registry = registry or MetricsRegistry()
+        self.access_key = access_key
+        self._telemetry = telemetry
+        cap = self.config.max_tenant_series
+        self._queries = self.registry.counter(
+            "pio_tenant_queries_total",
+            "Queries admitted per tenant at the multi-tenant gate",
+            labelnames=("tenant",), max_series=cap)
+        self._failures = self.registry.counter(
+            "pio_tenant_query_failures_total",
+            "Admitted queries that answered >= 400 per tenant (the "
+            "errors-SLO burn numerator)",
+            labelnames=("tenant",), max_series=cap)
+        self._hist = self.registry.histogram(
+            "pio_tenant_query_duration_seconds",
+            "Gate-to-answer wall time per tenant (the latency-SLO "
+            "burn source)",
+            labelnames=("tenant",), max_series=cap)
+        self._rejected = self.registry.counter(
+            "pio_tenant_admission_rejected_total",
+            "Queries 429'd at the gate because the tenant's SLO "
+            "budget is burning (NOT counted as tenant failures — "
+            "shedding must let the burn recover)",
+            labelnames=("tenant",), max_series=cap)
+        self._reload_timeouts = self.registry.counter(
+            "pio_tenant_reload_timeouts_total",
+            "Queries that hit a warm tenant and timed out waiting for "
+            "the warm reload (answered 503)",
+            labelnames=("tenant",), max_series=cap)
+        self.registry.gauge(
+            "pio_mt_device_budget_bytes",
+            "Configured device-memory residency budget "
+            "(0 = unlimited, never evict)").set(
+                float(self.config.budget_bytes))
+        #: construction order = route order; dict preserves it
+        self.tenants: Dict[str, Tenant] = {}
+        for spec in specs:
+            self.tenants[spec.name] = self._build_tenant(spec)
+        # each tenant's QueryServer re-pointed the shared registry's
+        # per-unit residency gauge at ITS OWN units; the host owns the
+        # truth — every tenant's units, tenant-labelled
+        register_capacity_metrics(self.registry, self._all_capacity_units)
+        self.registry.gauge_callback(
+            "pio_tenant_resident_bytes",
+            "Device-resident factor bytes per tenant (0 while evicted "
+            "to warm state)",
+            self._resident_samples, labelnames=("tenant",))
+        self.registry.gauge_callback(
+            "pio_mt_resident_bytes_total",
+            "Device-resident factor bytes across all tenants (the "
+            "number the budgeter keeps under pio_mt_device_budget_bytes)",
+            lambda: float(self.resident_bytes()))
+        self._sweep_task: Optional[asyncio.Task] = None
+        self._slo_task: Optional[asyncio.Task] = None
+        self.app = web.Application(middlewares=[
+            observability_middleware(self.registry, "multitenant")])
+        self._routes()
+        self.app.on_startup.append(self._on_startup)
+        self.app.on_cleanup.append(self._on_cleanup)
+
+    # -- construction --------------------------------------------------------
+    def _build_tenant(self, spec: TenantSpec) -> Tenant:
+        kwargs: Dict[str, Any] = {}
+        for key in ("release", "scorer_config", "serving_config",
+                    "deploy_config", "foldin_config"):
+            value = getattr(spec, key)
+            if value is not None:
+                kwargs[key] = value
+        server = QueryServer(
+            spec.engine, spec.train_result, spec.instance, spec.ctx,
+            access_key=self.access_key, registry=self.registry,
+            pin_process_scorer=False, **kwargs)
+        slo = self._build_slo(spec)
+        return Tenant(spec.name, server, slo)
+
+    def _build_slo(self, spec: TenantSpec) -> Optional[SLOEngine]:
+        """A per-tenant burn-rate engine over the HOST's tenant-labelled
+        metrics. Objective names get a ``{tenant}:`` prefix — all N
+        engines share one registry, and ``pio_slo_*`` label by
+        objective name."""
+        if not spec.slo:
+            return None
+        data = dict(spec.slo)
+        data["objectives"] = [
+            {**o, "name": f"{spec.name}:{o.get('name', o.get('kind', 'slo'))}"}
+            for o in data.get("objectives", ())]
+        parsed = SLOSpec.from_dict(data)
+        if parsed is None:
+            return None
+        name = spec.name
+
+        def _errors(obj) -> Tuple[float, float]:
+            return (self._failures.value(tenant=name),
+                    self._queries.value(tenant=name))
+
+        def _latency(obj) -> Tuple[float, float]:
+            total = self._hist.count(tenant=name)
+            bad = total - self._hist.count_below(obj.threshold_s,
+                                                 tenant=name)
+            return bad, total
+
+        return SLOEngine(self.registry, parsed,
+                         sources={KIND_ERRORS: _errors,
+                                  KIND_LATENCY: _latency})
+
+    def _routes(self) -> None:
+        r = self.app.router
+        r.add_get("/", self.handle_root)
+        r.add_get("/tenants.json", self.handle_tenants)
+        r.add_get("/residency.json", self.handle_residency)
+        # the gate needs EXACT per-tenant resources: the router's index
+        # walk tries the longest matching path first, so a plain
+        # /t/<name>/queries.json outranks the subapp's /t/<name> prefix
+        # (a dynamic /t/{tenant} route would index under /t and lose).
+        # Queries therefore route through admission + residency while
+        # every other per-tenant endpoint (deploy, reload, slo,
+        # capacity...) falls through to the tenant's own app
+        for name in self.tenants:
+            r.add_post(f"/t/{name}/queries.json", self.handle_tenant_query)
+        # unknown tenants land on the dynamic fallback for a clean 404
+        r.add_post("/t/{tenant}/queries.json", self.handle_tenant_query)
+        add_capacity_route(self.app, self._all_capacity_units)
+        add_metrics_routes(self.app, self.registry, default_registry())
+        for name, tenant in self.tenants.items():
+            self.app.add_subapp(f"/t/{name}/", tenant.server.app)
+
+    # -- lifecycle -----------------------------------------------------------
+    async def _on_startup(self, app) -> None:
+        if self.config.budget_bytes > 0:
+            self._sweep_task = asyncio.get_running_loop().create_task(
+                self._sweep_loop())
+        intervals = [t.slo.spec.eval_interval_s
+                     for t in self.tenants.values() if t.slo is not None]
+        if intervals:
+            self._slo_task = asyncio.get_running_loop().create_task(
+                self._slo_loop(min(intervals)))
+        logger.info(
+            "multi-tenant host up: %d tenant(s) [%s], budget %s bytes, "
+            "admission %s", len(self.tenants),
+            ", ".join(self.tenants), self.config.budget_bytes or "off",
+            "on" if self.config.admission else "off")
+
+    async def _on_cleanup(self, app) -> None:
+        for task in (self._sweep_task, self._slo_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        # tenant QueryServer cleanups run via their subapps' signals;
+        # the host only owns the shared recorder
+        if self._telemetry is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._telemetry.stop)
+
+    async def _sweep_loop(self) -> None:
+        """Background LRU budget sweep: a standby/canary growing a
+        tenant past the budget gets corrected within one interval even
+        if that tenant is never queried again."""
+        while True:
+            await asyncio.sleep(self.config.sweep_interval_s)
+            try:
+                await self.enforce_budget()
+            except Exception:
+                logger.exception("residency sweep failed")
+
+    async def _slo_loop(self, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            for tenant in self.tenants.values():
+                if tenant.slo is None:
+                    continue
+                try:
+                    tenant.slo.tick()
+                except Exception:
+                    logger.exception("SLO evaluation failed for tenant %s",
+                                     tenant.name)
+
+    # -- residency budgeter --------------------------------------------------
+    def _resident_tenants(self) -> List[Tenant]:
+        return [t for t in self.tenants.values() if t.server.resident]
+
+    def resident_bytes(self) -> int:
+        """Live device-resident attribution across all tenants (warm
+        tenants contribute 0 — their remembered footprint only enters
+        as the RELOAD projection)."""
+        return sum(t.server.warm_bytes for t in self._resident_tenants())
+
+    def _resident_samples(self):
+        return [({"tenant": t.name},
+                 float(t.server.warm_bytes if t.server.resident else 0))
+                for t in self.tenants.values()]
+
+    def _all_capacity_units(self) -> List[dict]:
+        units: List[dict] = []
+        for tenant in self.tenants.values():
+            for unit in tenant.server._capacity_units():
+                units.append({**unit, "tenant": tenant.name})
+        return units
+
+    async def _evict_lru(self, exclude: Tuple[str, ...] = (),
+                         reason: str = "budget") -> bool:
+        """Evict the least-recently-queried resident tenant (skipping
+        ``exclude`` and tenants mid-canary — the judge needs its
+        incumbent baseline). True when something was evicted."""
+        candidates = sorted(
+            (t for t in self._resident_tenants()
+             if t.name not in exclude and t.server._canary is None),
+            key=lambda t: t.last_hit)
+        for tenant in candidates:
+            if await tenant.server.evict_to_warm(reason):
+                logger.info("evicted tenant %s (%s)", tenant.name, reason)
+                return True
+        return False
+
+    async def enforce_budget(self) -> None:
+        """Evict LRU tenants until resident bytes fit the budget,
+        never below the ``min_resident`` floor."""
+        budget = self.config.budget_bytes
+        if budget <= 0:
+            return
+        while (self.resident_bytes() > budget
+               and len(self._resident_tenants()) > self.config.min_resident):
+            if not await self._evict_lru():
+                return
+
+    async def ensure_tenant_resident(self, tenant: Tenant) -> bool:
+        """The miss path: make room for the tenant's projected reload
+        footprint (its last resident attribution), drive the warm-reload
+        ladder, then re-enforce against the ACTUAL bytes (a projection
+        is last cycle's truth, not this one's)."""
+        budget = self.config.budget_bytes
+        if not tenant.server.resident and budget > 0:
+            while (self.resident_bytes() + tenant.server.warm_bytes > budget
+                   and await self._evict_lru(exclude=(tenant.name,))):
+                pass
+        ok = await tenant.server.ensure_resident(
+            wait_s=self.config.reload_wait_s)
+        if ok and budget > 0:
+            while (self.resident_bytes() > budget
+                   and await self._evict_lru(exclude=(tenant.name,))):
+                pass
+        return ok
+
+    # -- the gate ------------------------------------------------------------
+    async def handle_tenant_query(self, request) -> web.Response:
+        # exact per-tenant routes carry no match_info; the path shape
+        # is fixed (/t/<name>/queries.json) so the name is segment 2
+        name = request.match_info.get("tenant") or request.path.split("/")[2]
+        tenant = self.tenants.get(name)
+        if tenant is None:
+            return web.json_response(
+                {"message": f"unknown tenant {name!r}"}, status=404)
+        if (self.config.admission and tenant.slo is not None
+                and tenant.slo.breached(exclude_kinds=(KIND_FRESHNESS,))):
+            self._rejected.inc(tenant=name)
+            return web.json_response(
+                {"message": f"tenant {name!r} SLO budget is burning; "
+                            "shedding load"},
+                status=429,
+                headers={"Retry-After":
+                         f"{self.config.retry_after_s:g}"})
+        tenant.last_hit = time.monotonic()
+        if not tenant.server.resident:
+            if not await self.ensure_tenant_resident(tenant):
+                self._reload_timeouts.inc(tenant=name)
+                return web.json_response(
+                    {"message": f"tenant {name!r} is reloading; retry"},
+                    status=503,
+                    headers={"Retry-After":
+                             f"{self.config.retry_after_s:g}"})
+        t0 = time.perf_counter()
+        self._queries.inc(tenant=name)
+        try:
+            response = await tenant.server.handle_query(request)
+        except Exception:
+            self._failures.inc(tenant=name)
+            self._hist.observe(time.perf_counter() - t0, tenant=name)
+            raise
+        self._hist.observe(time.perf_counter() - t0, tenant=name)
+        if response.status >= 400:
+            self._failures.inc(tenant=name)
+        return response
+
+    # -- status surfaces -----------------------------------------------------
+    def _tenant_doc(self, tenant: Tenant) -> dict:
+        server = tenant.server
+        return {
+            "tenant": tenant.name,
+            "resident": server.resident,
+            "residentBytes": server.warm_bytes if server.resident else 0,
+            "warmBytes": 0 if server.resident else server.warm_bytes,
+            "lastHitAgoS": round(time.monotonic() - tenant.last_hit, 3),
+            "canary": server._canary is not None,
+            "slo": (tenant.slo.breached(exclude_kinds=(KIND_FRESHNESS,))
+                    if tenant.slo is not None else None),
+            "engineInstanceId": server.instance.id,
+            "scorerMode": server.scorer_config.mode,
+        }
+
+    async def handle_root(self, request) -> web.Response:
+        return web.json_response({
+            "status": "alive",
+            "tenants": list(self.tenants),
+            "budgetBytes": self.config.budget_bytes,
+            "residentBytes": self.resident_bytes(),
+            "admission": self.config.admission,
+        })
+
+    async def handle_tenants(self, request) -> web.Response:
+        return web.json_response({
+            "tenants": [self._tenant_doc(t)
+                        for t in self.tenants.values()]})
+
+    async def handle_residency(self, request) -> web.Response:
+        resident = self._resident_tenants()
+        return web.json_response({
+            "budgetBytes": self.config.budget_bytes,
+            "residentBytes": self.resident_bytes(),
+            "residentTenants": len(resident),
+            "minResident": self.config.min_resident,
+            "tenants": [self._tenant_doc(t)
+                        for t in self.tenants.values()],
+        })
+
+
+def create_multitenant_server(specs: List[TenantSpec],
+                              **kwargs) -> MultiTenantServer:
+    return MultiTenantServer(specs, **kwargs)
+
+
+def run_multitenant_server(specs: List[TenantSpec],
+                           ip: str = "localhost",
+                           port: int = DEFAULT_PORT,
+                           **kwargs) -> None:
+    from predictionio_tpu.utils.server_config import ServerConfig
+
+    cfg = ServerConfig.load()
+    kwargs.setdefault("access_key", cfg.key or None)
+    kwargs.setdefault("config", cfg.multitenant)
+    if "telemetry" not in kwargs:
+        from predictionio_tpu.obs.telemetry import build_recorder
+
+        registry = kwargs.setdefault("registry", MetricsRegistry())
+        kwargs["telemetry"] = build_recorder(
+            "multitenant", cfg.telemetry, instance=str(port),
+            registries=[registry, default_registry()])
+    server = create_multitenant_server(specs, **kwargs)
+    ssl_ctx = cfg.ssl_context()
+    logger.info("Multi-tenant server listening on %s:%s%s (%d tenants)",
+                ip, port, " (TLS)" if ssl_ctx else "", len(server.tenants))
+    web.run_app(server.app, host=ip, port=port,
+                ssl_context=ssl_ctx, print=None)

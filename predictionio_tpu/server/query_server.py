@@ -442,7 +442,8 @@ class QueryServer:
                  foldin_config: Optional[FoldinConfig] = None,
                  scorer_config: Optional[ScorerConfig] = None,
                  slo_spec: Optional[SLOSpec] = None,
-                 telemetry=None):
+                 telemetry=None,
+                 pin_process_scorer: bool = True):
         self.engine = engine
         self.feedback = feedback
         self.feedback_app_name = feedback_app_name
@@ -476,7 +477,16 @@ class QueryServer:
         from predictionio_tpu.ops import scoring as _scoring
 
         self.scorer_config = scorer_config or ScorerConfig.from_env()
-        _scoring.set_process_scorer_config(self.scorer_config)
+        #: multi-tenant hosting passes pin_process_scorer=False: N
+        #: co-hosted servers cannot all own the ONE process pin, so each
+        #: stamps its resolved config onto its own model holders instead
+        #: (ops/scoring.holder_scorer_config) — tenant A can hold int8
+        #: residency while tenant B holds bf16 in the same process
+        self._pin_process_scorer = bool(pin_process_scorer)
+        if self._pin_process_scorer:
+            _scoring.set_process_scorer_config(self.scorer_config)
+        else:
+            self._stamp_scorer_override(train_result)
         #: online fold-in controller (deploy/foldin.py), started on the
         #: server loop when enabled AND the engine supports it
         self._foldin = None
@@ -552,6 +562,19 @@ class QueryServer:
         self._reload_total = self.registry.counter(
             "pio_reload_total", "Model reload attempts by outcome",
             labelnames=("status",))
+        #: warm-eviction residency state (multi-tenant budgeter): an
+        #: evicted server keeps serving a WARM unit (instance + registry
+        #: release pointer retained, factors dropped) and reloads through
+        #: the warmup ladder on the next hit — `_reload_event` is the
+        #: single-flight latch queries wait on, `_warm_bytes` remembers
+        #: the last resident attribution for pre-reload budget projection
+        self._reload_event: Optional[asyncio.Event] = None
+        self._warm_bytes: int = 0
+        self._evict_total = self.registry.counter(
+            "pio_unit_evictions_total",
+            "Serving units evicted to warm on-host state (factors "
+            "dropped, params + release pointer retained)",
+            labelnames=("reason",))
         #: SLO burn-rate engine (obs/slo.py) when the host configured a
         #: server.json "slo" section — evaluated periodically on the loop
         #: and on-demand at /slo.json; canary + fold-in gating consume it
@@ -768,7 +791,10 @@ class QueryServer:
                 "startTime": self.instance.start_time.isoformat(),
                 "releaseVersion": self._unit.release_version or None,
             },
-            "algorithms": [type(a).__name__ for a in self.result.algorithms],
+            "resident": self.resident,
+            "algorithms": [type(a).__name__ for a in
+                           (self.result.algorithms
+                            if self.result is not None else ())],
             "startTime": self.start_time.isoformat(),
             "uptimeSeconds": uptime,
             "requestCount": int(count),
@@ -813,6 +839,18 @@ class QueryServer:
         # (result, vectorized flag, batcher) rides that one reference, so
         # a concurrent swap can never hand it mismatched halves
         role, unit, canary = ROLE_INCUMBENT, self._unit, self._canary
+        if unit.result is None:
+            # warm-evicted: factors were dropped under the device-memory
+            # budget. Kick (or join) the single-flight reload and wait,
+            # bounded — past the bound the client gets a clean 503 with
+            # Retry-After rather than an unbounded queue
+            if not await self.ensure_resident():
+                self._query_failures.inc(engine_variant=variant,
+                                         reason="not_resident")
+                return web.json_response(
+                    {"message": "serving unit is reloading; retry"},
+                    status=503, headers={"Retry-After": "1"})
+            role, unit, canary = ROLE_INCUMBENT, self._unit, self._canary
         if canary is not None and canary.controller.decided is None:
             if canary.controller.splitter.route():
                 role, unit = ROLE_CANARY, canary.unit
@@ -883,6 +921,8 @@ class QueryServer:
         return web.json_response(pred_json)
 
     def _extract_query(self, body: dict):
+        if self.result is None:        # warm-evicted: no algorithms to ask
+            return body
         qc = _query_class(self.result)
         if qc is None:
             return body
@@ -1112,6 +1152,7 @@ class QueryServer:
             unit = await loop.run_in_executor(
                 self._deploy_executor, build_unit, self.engine, instance,
                 release)
+        self._stamp_scorer_override(unit.result)
         self._attach_batcher(unit)
         predict_batch = functools.partial(self._predict_batch_unit, unit)
         explicit_q = None
@@ -1165,6 +1206,148 @@ class QueryServer:
         logger.info("swapped to engine instance %s (%s: %s)",
                     unit.instance.id, mode, reason)
 
+    # -- warm eviction / reload (multi-tenant residency budgeter) ------------
+    def _stamp_scorer_override(self, result) -> None:
+        """When this server does NOT own the process scorer pin (a
+        multi-tenant host serves many servers in one process), stamp the
+        per-tenant scorer config onto every model holder so
+        ``holder_scorer_config`` resolves it instead of the process pin —
+        tenant A can stay int8 while tenant B scores bf16."""
+        if self._pin_process_scorer or result is None:
+            return
+        for model in getattr(result, "models", ()) or ():
+            try:
+                model._scorer_cfg_override = self.scorer_config
+            except Exception:  # frozen/odd holders: fall back to process pin
+                pass
+
+    @property
+    def resident(self) -> bool:
+        """Whether the active unit holds device-resident factors."""
+        return self._unit.result is not None
+
+    @property
+    def warm_bytes(self) -> int:
+        """Last known resident attribution: live bytes while resident,
+        the pre-eviction footprint while warm (the budgeter's projection
+        of what a reload will cost)."""
+        if self.resident:
+            return int(sum(u.get("residentBytes", 0)
+                           for u in self._capacity_units()))
+        return self._warm_bytes
+
+    async def evict_to_warm(self, reason: str = "budget") -> bool:
+        """Drop the active unit to warm on-host state: the instance and
+        registry release pointer stay, the factors (TrainResult, scorer
+        caches, standby) go. Runs under the `_swap_lock` discipline — the
+        cutover installs a NEW factor-less ServingUnit, so a fold-in
+        compare-and-swap racing the eviction loses cleanly
+        (FoldinSwapRaced) instead of resurrecting dropped factors.
+
+        Refused (returns False) while a canary window is open (the judge
+        would lose its incumbent baseline), while a reload is already in
+        flight, and on an already-warm unit."""
+        from predictionio_tpu.storage import faults
+
+        if self._canary is not None or self._reload_event is not None:
+            return False
+        with self._swap_lock:
+            old = self._unit
+            if old.result is None:
+                return False
+            warm = ServingUnit(
+                instance=old.instance, result=None, ctx=old.ctx,
+                vectorized=False, release=old.release)
+            self._unit = warm
+        # attribution BEFORE the factors drop: the budgeter projects the
+        # reload cost from this number
+        self._warm_bytes = int(
+            unit_capacity(old, "active").get("residentBytes", 0))
+        standby, self._standby = self._standby, None
+        # in-flight and already-queued batches finish on the old unit's
+        # own batcher (they score on the factors they were promised)
+        await self._retire_batcher(old)
+        faults.maybe_kill("mt:evict:drained")
+        old.result = None
+        old.batcher = None
+        old.foldin_of = None
+        if standby is not None:
+            standby.result = None
+            standby.batcher = None
+            standby.foldin_of = None
+        self._evict_total.inc(reason=reason)
+        self._deploy.swap_total.inc(mode="evict", outcome="ok")
+        record_event("evict", {
+            "reason": reason,
+            "engineInstanceId": warm.instance.id,
+            "releaseVersion": warm.release_version or None,
+            "residentBytes": self._warm_bytes})
+        logger.info("evicted instance %s to warm state (%s, %d bytes)",
+                    warm.instance.id, reason, self._warm_bytes)
+        faults.maybe_kill("mt:evict:committed")
+        return True
+
+    async def ensure_resident(self, wait_s: Optional[float] = None) -> bool:
+        """Queries hitting a warm unit call this: start (or join) the
+        single-flight warm reload and wait for it, bounded by ``wait_s``
+        (default: the deploy drain timeout). True when the active unit is
+        resident on return."""
+        if self._unit.result is not None:
+            return True
+        ev = self._reload_event
+        if ev is None:
+            self._reload_event = ev = asyncio.Event()
+            self._spawn(self._reload_from_warm(ev))
+        timeout = (wait_s if wait_s is not None
+                   else self.deploy_config.drain_timeout_s)
+        try:
+            await asyncio.wait_for(asyncio.shield(ev.wait()), timeout)
+        except asyncio.TimeoutError:
+            return False
+        return self._unit.result is not None
+
+    async def _reload_from_warm(self, ev: asyncio.Event) -> None:
+        """The reload half of the eviction cycle: drive the SAME
+        load -> warmup -> verify ladder a deploy uses (the unit that
+        swaps in is fully compiled and health-checked — never
+        half-resident), then compare-and-swap it over the warm
+        placeholder. A deploy/rollback that landed mid-reload wins: the
+        reloaded unit is discarded, never silently installed."""
+        from predictionio_tpu.storage import faults
+
+        warm = self._unit
+        try:
+            unit = await self._prepare_unit(warm.instance, warm.release)
+            faults.maybe_kill("mt:reload:loaded")
+            with self._swap_lock:
+                raced = self._unit is not warm
+                if not raced:
+                    self._unit = unit
+            if raced:
+                if unit.batcher is not None:
+                    await unit.batcher.shutdown()
+                unit.result = None
+                self._reload_total.inc(status="warm_reload_raced")
+                return
+            self._deploy.swap_total.inc(mode="warm_reload", outcome="ok")
+            self._deploy.active_version.set(float(unit.release_version))
+            self._reload_total.inc(status="warm_reload")
+            record_event("swap", {
+                "mode": "warm_reload",
+                "engineInstanceId": unit.instance.id,
+                "releaseVersion": unit.release_version or None})
+            faults.maybe_kill("mt:reload:committed")
+        except DeployError:
+            self._reload_total.inc(status="warm_reload_failed")
+            self._deploy.swap_total.inc(mode="warm_reload",
+                                        outcome="failed")
+            logger.exception("warm reload failed; unit stays warm")
+        finally:
+            # waiters wake either way: resident -> serve, still warm ->
+            # clean 503 (and the next hit retries the reload)
+            self._reload_event = None
+            ev.set()
+
     # -- online fold-in cutover (deploy/foldin.py) ---------------------------
     def build_foldin_unit(self, new_models, applied_rows: int,
                           drift_release: Optional[Release] = None,
@@ -1181,6 +1364,7 @@ class QueryServer:
             release=drift_release or base.release)
         unit.foldin_of = base.foldin_of or base
         unit.foldin_rows = base.foldin_rows + applied_rows
+        self._stamp_scorer_override(result)
         return unit
 
     def swap_foldin_unit(self, unit: ServingUnit, loop=None,
@@ -1604,6 +1788,7 @@ class QueryServer:
                        if self._foldin is not None
                        else {"enabled": False}),
             "scorer": self._scorer_status(),
+            "resident": self.resident,
         })
 
     def _scorer_status(self) -> dict:

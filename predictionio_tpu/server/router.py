@@ -326,6 +326,11 @@ class Router:
         r = self.app.router
         r.add_get("/", self.handle_root)
         r.add_post("/queries.json", self.handle_query)
+        # multi-tenant replicas (server/multitenant.py): the tenant
+        # path segment rides through to the replica's own gate, so
+        # admission/residency decisions stay at the replica where the
+        # tenant's SLO engine and budgeter live
+        r.add_post("/t/{tenant}/queries.json", self.handle_tenant_query)
         r.add_get("/slo.json", self.handle_slo)
         r.add_get("/fleet/status.json", self.handle_fleet_status)
         r.add_post("/deploy.json", self.handle_deploy)
@@ -538,6 +543,14 @@ class Router:
         })
 
     async def handle_query(self, request) -> web.Response:
+        return await self._proxy_query(request, "/queries.json")
+
+    async def handle_tenant_query(self, request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        return await self._proxy_query(
+            request, f"/t/{tenant}/queries.json")
+
+    async def _proxy_query(self, request, path: str) -> web.Response:
         body = await request.read()
         headers = {"Content-Type": "application/json"}
         ctx = capture_context()
@@ -565,7 +578,7 @@ class Router:
             try:
                 timeout = aiohttp.ClientTimeout(total=PROXY_TIMEOUT_S)
                 async with self._session.post(
-                        f"{handle.url}/queries.json", data=body,
+                        f"{handle.url}{path}", data=body,
                         headers=headers, params=request.query,
                         timeout=timeout) as resp:
                     payload = await resp.read()

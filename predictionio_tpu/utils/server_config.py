@@ -1205,6 +1205,89 @@ def loadtest_config() -> LoadtestConfig:
     return LoadtestConfig.from_env(read_server_json().get("loadtest") or {})
 
 
+@dataclasses.dataclass
+class MultiTenantConfig:
+    """Multi-tenant host tuning (the ``PIO_MT_*`` knobs; server.json
+    ``multitenant`` section, camelCase keys; env overrides the file,
+    the established precedence).
+
+    ``budget_bytes`` is the shared device-memory residency budget the
+    host keeps all tenants' scorer factors under (0 = unlimited: never
+    evict). ``reload_wait_s`` bounds how long a query hitting a warm
+    (evicted) tenant waits for the warm-reload ladder before a clean
+    503. ``sweep_interval_s`` paces the background LRU budget sweep,
+    ``min_resident`` is the floor the sweep never evicts below,
+    ``admission`` arms the per-tenant SLO-burn 429 path and
+    ``retry_after_s`` is the Retry-After it advertises.
+    ``max_tenant_series`` caps the per-metric series the ``tenant``
+    label may create before new tenants collapse into the registry's
+    ``other`` overflow bucket (established tenants keep their series).
+    """
+
+    budget_bytes: int = 0
+    reload_wait_s: float = 10.0
+    sweep_interval_s: float = 2.0
+    min_resident: int = 1
+    admission: bool = True
+    retry_after_s: float = 1.0
+    max_tenant_series: int = 256
+
+    @classmethod
+    def from_env(cls, data: Optional[dict] = None) -> "MultiTenantConfig":
+        """server.json ``multitenant`` section overlaid by ``PIO_MT_*``
+        env vars (env wins); malformed knobs are logged and fall back,
+        same contract as ServingConfig."""
+        data = data or {}
+        cfg = cls()
+        as_bool = lambda v: str(v).strip().lower() not in (  # noqa: E731
+            "0", "false", "no", "off", "")
+        file_keys = (
+            ("budgetBytes", "budget_bytes", int),
+            ("reloadWaitS", "reload_wait_s", float),
+            ("sweepIntervalS", "sweep_interval_s", float),
+            ("minResident", "min_resident", int),
+            ("admission", "admission", as_bool),
+            ("retryAfterS", "retry_after_s", float),
+            ("maxTenantSeries", "max_tenant_series", int),
+        )
+        env_keys = (
+            ("PIO_MT_DEVICE_BUDGET_BYTES", "budget_bytes", int),
+            ("PIO_MT_RELOAD_WAIT_S", "reload_wait_s", float),
+            ("PIO_MT_SWEEP_INTERVAL_S", "sweep_interval_s", float),
+            ("PIO_MT_MIN_RESIDENT", "min_resident", int),
+            ("PIO_MT_ADMISSION", "admission", as_bool),
+            ("PIO_MT_RETRY_AFTER_S", "retry_after_s", float),
+            ("PIO_MT_MAX_TENANT_SERIES", "max_tenant_series", int),
+        )
+        sources = (
+            [(k, data.get(k), attr, conv) for k, attr, conv in file_keys]
+            + [(k, os.environ.get(k), attr, conv)
+               for k, attr, conv in env_keys]
+        )
+        for name, raw, attr, conv in sources:
+            if raw is None or raw == "":
+                continue
+            try:
+                setattr(cfg, attr, conv(raw))
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed multitenant knob %s=%r",
+                               name, raw)
+        cfg.budget_bytes = max(0, cfg.budget_bytes)
+        cfg.reload_wait_s = max(0.1, cfg.reload_wait_s)
+        cfg.sweep_interval_s = max(0.05, cfg.sweep_interval_s)
+        cfg.min_resident = max(0, cfg.min_resident)
+        cfg.retry_after_s = max(0.0, cfg.retry_after_s)
+        cfg.max_tenant_series = max(1, cfg.max_tenant_series)
+        return cfg
+
+
+def multitenant_config() -> MultiTenantConfig:
+    """Resolve the multi-tenant host knobs: server.json ``multitenant``
+    section overlaid by ``PIO_MT_*`` env."""
+    return MultiTenantConfig.from_env(
+        read_server_json().get("multitenant") or {})
+
+
 def read_server_json(path: Optional[str] = None) -> dict:
     """The raw server.json contents ({} when absent/unreadable) — the
     shared file read behind ServerConfig.load and the per-section
@@ -1241,6 +1324,8 @@ class ServerConfig:
         default_factory=OrchestratorConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
+    multitenant: MultiTenantConfig = dataclasses.field(
+        default_factory=MultiTenantConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "ServerConfig":
@@ -1264,6 +1349,8 @@ class ServerConfig:
                 data.get("orchestrator") or {}),
             telemetry=TelemetryConfig.from_env(
                 data.get("telemetry") or {}),
+            multitenant=MultiTenantConfig.from_env(
+                data.get("multitenant") or {}),
         )
         if os.environ.get("PIO_SERVER_KEY"):
             cfg.key = os.environ["PIO_SERVER_KEY"]

@@ -1,0 +1,580 @@
+"""Multi-tenant serving host (server/multitenant.py) + the warm
+eviction/reload cycle (server/query_server.py).
+
+Covers the ISSUE's acceptance paths:
+  * routing/isolation — N tenants behind /t/{name}/queries.json in ONE
+    process, each answering from its OWN factors, with the per-tenant
+    deploy/status surface reachable through the subapp fallthrough;
+  * eviction/reload correctness — answers byte-identical across a full
+    evict -> warm-reload cycle, the unit never observable half-resident
+    (kill-point chaos at all four boundaries), queries during a reload
+    either wait-bounded or 503 cleanly, and a deploy racing a warm
+    reload wins (the reloaded unit is discarded, never silently
+    installed);
+  * the residency budgeter — an undersized PIO_MT_DEVICE_BUDGET_BYTES
+    evicts the least-recently-queried tenant, on the miss path AND the
+    background sweep, never below min_resident;
+  * admission control — a tenant whose SLO budget burns is 429'd (with
+    Retry-After) while the quiet tenant keeps answering 200;
+  * tenant label cardinality — the `tenant` label rides the registry's
+    max_series overflow guard: an explosion collapses into `other`
+    WITHOUT losing established tenants' series.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.core.engine import Engine, TrainResult
+from predictionio_tpu.core.params import EngineParams
+from predictionio_tpu.deploy.releases import record_release
+from predictionio_tpu.engines.recommendation import (
+    ALSAlgorithm, AlgorithmParams, DataSourceParams,
+    RecommendationDataSource, RecommendationPreparator,
+    RecommendationServing,
+)
+from predictionio_tpu.models.als import ALSModel
+from predictionio_tpu.server.multitenant import (
+    MultiTenantServer, TenantSpec,
+)
+from predictionio_tpu.storage import Model, Storage
+from predictionio_tpu.storage.base import EngineInstance
+from predictionio_tpu.storage.faults import CrashError, set_kill_points
+from predictionio_tpu.utils.server_config import (
+    DeployConfig, MultiTenantConfig, ServingConfig,
+)
+from predictionio_tpu.workflow.serialization import serialize_models
+
+pytestmark = pytest.mark.anyio
+
+ENGINE_ID = ("predictionio_tpu.engines.recommendation."
+             "RecommendationEngineFactory")
+RANK = 8
+
+
+def make_model(seed=0, n_users=24, n_items=120, rank=RANK) -> ALSModel:
+    rng = np.random.default_rng(seed)
+    return ALSModel(
+        user_vocab=np.sort(np.asarray(
+            [f"u{i}" for i in range(n_users)], dtype=object)),
+        item_vocab=np.sort(np.asarray(
+            [f"i{i}" for i in range(n_items)], dtype=object)),
+        U=rng.normal(size=(n_users, rank)).astype(np.float32),
+        V=rng.normal(size=(n_items, rank)).astype(np.float32))
+
+
+def make_engine() -> Engine:
+    return Engine(
+        data_source_classes=RecommendationDataSource,
+        preparator_classes=RecommendationPreparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_classes=RecommendationServing,
+    )
+
+
+@pytest.fixture()
+def mt_store(tmp_path):
+    Storage.configure({
+        "sources": {"DB": {"TYPE": "sqlite",
+                           "PATH": str(tmp_path / "mt.db")}},
+        "repositories": {
+            "METADATA": {"NAME": "pio", "SOURCE": "DB"},
+            "EVENTDATA": {"NAME": "pio", "SOURCE": "DB"},
+            "MODELDATA": {"NAME": "pio", "SOURCE": "DB"},
+        },
+    })
+    yield Storage
+    Storage.reset()
+
+
+@pytest.fixture()
+def device_resident(monkeypatch):
+    """Pin the roundtrip estimate to zero so scoring takes the device
+    lane — that is what populates the models' resident/scorer caches the
+    capacity ledger attributes bytes from."""
+    import predictionio_tpu.models.als as als_mod
+
+    monkeypatch.setattr(als_mod, "_DEVICE_ROUNDTRIP_S", 0.0)
+
+
+def make_tenant_spec(name, seed, n_items=120, slo=None) -> TenantSpec:
+    """A persisted, reloadable tenant: instance + serialized model +
+    release in Storage so the warm-reload ladder has something to
+    deserialize."""
+    model = make_model(seed=seed, n_items=n_items)
+    instance = EngineInstance(
+        id=f"mt-{name}", status="COMPLETED", engine_id=ENGINE_ID,
+        engine_version="1", engine_variant=name,
+        data_source_params=json.dumps({"app_name": f"{name}App"}),
+        algorithms_params='[{"name": "als", "params": {"rank": %d}}]'
+        % RANK)
+    Storage.get_meta_data_engine_instances().insert(instance)
+    blob = serialize_models([model])
+    Storage.get_model_data_models().insert(Model(id=instance.id,
+                                                 models=blob))
+    release = record_release(instance, train_seconds=1.0, blob=blob)
+    result = TrainResult(
+        models=[model],
+        algorithms=[ALSAlgorithm(AlgorithmParams(rank=RANK))],
+        serving=RecommendationServing(),
+        engine_params=EngineParams(
+            data_source_params=DataSourceParams(app_name=f"{name}App")))
+    return TenantSpec(
+        name=name, engine=make_engine(), train_result=result,
+        instance=instance, ctx=None, release=release,
+        serving_config=ServingConfig(batch_max=8, batch_linger_s=0.0),
+        deploy_config=DeployConfig(warmup=False, drain_timeout_s=5.0),
+        slo=slo)
+
+
+def make_host(specs, **cfg) -> MultiTenantServer:
+    defaults = dict(budget_bytes=0, reload_wait_s=5.0,
+                    sweep_interval_s=60.0, min_resident=0)
+    defaults.update(cfg)
+    return MultiTenantServer(specs, config=MultiTenantConfig(**defaults))
+
+
+async def query(client, tenant, user="u1", num=3):
+    return await client.post(f"/t/{tenant}/queries.json",
+                             json={"user": user, "num": num})
+
+
+async def scores(client, tenant, user="u1", num=3):
+    r = await query(client, tenant, user, num)
+    assert r.status == 200, await r.text()
+    return (await r.json())["itemScores"]
+
+
+# ---------------------------------------------------------------------------
+# construction + routing
+# ---------------------------------------------------------------------------
+
+def test_tenant_name_validation(mt_store):
+    good = make_tenant_spec("ok-name", seed=1)
+    for bad in ("", "a/b", "a b", "-lead", "{x}"):
+        spec = TenantSpec(
+            name=bad, engine=good.engine, train_result=good.train_result,
+            instance=good.instance, ctx=None)
+        with pytest.raises(ValueError):
+            make_host([spec])
+    with pytest.raises(ValueError):
+        make_host([good, good])        # duplicate names
+    with pytest.raises(ValueError):
+        make_host([])
+
+
+async def test_routing_isolation_and_surfaces(mt_store):
+    """Three engine variants in one process: each tenant answers from
+    its own factors, the host surfaces list them, and the per-tenant
+    deploy surface is reachable through the subapp fallthrough."""
+    host = make_host([make_tenant_spec("alpha", seed=1),
+                      make_tenant_spec("beta", seed=2),
+                      make_tenant_spec("gamma", seed=3)])
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        got = {t: await scores(c, t) for t in ("alpha", "beta", "gamma")}
+        # distinct factor seeds -> distinct rankings: proof each tenant
+        # scored on ITS unit, not a shared one
+        assert len({json.dumps(v) for v in got.values()}) == 3
+        assert all(len(v) == 3 for v in got.values())
+
+        r = await c.get("/")
+        doc = await r.json()
+        assert doc["tenants"] == ["alpha", "beta", "gamma"]
+
+        r = await c.get("/tenants.json")
+        listing = (await r.json())["tenants"]
+        assert [t["tenant"] for t in listing] == ["alpha", "beta", "gamma"]
+        assert all(t["resident"] for t in listing)
+
+        # subapp fallthrough: the tenant's OWN deploy surface
+        r = await c.get("/t/beta/deploy/status.json")
+        status = await r.json()
+        assert status["resident"] is True
+        assert status["active"]["engineInstanceId"] == "mt-beta"
+
+        r = await query(c, "nosuch")
+        assert r.status == 404
+
+        # per-tenant gate metrics moved
+        assert host._queries.value(tenant="alpha") == 1
+        assert host._queries.value(tenant="gamma") == 1
+    finally:
+        await c.close()
+
+
+# ---------------------------------------------------------------------------
+# evict -> reload correctness
+# ---------------------------------------------------------------------------
+
+async def test_evict_reload_byte_identical(mt_store, device_resident):
+    """A full evict -> warm-reload cycle: factors drop (resident bytes
+    attributed, then zero), the next query reloads through the warmup
+    ladder, and answers are byte-identical pre/post."""
+    host = make_host([make_tenant_spec("alpha", seed=1)])
+    tenant = host.tenants["alpha"]
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        before = {u: await scores(c, "alpha", user=u)
+                  for u in ("u1", "u5", "nosuchuser")}
+        assert tenant.server.resident
+        assert await tenant.server.evict_to_warm("test") is True
+        assert not tenant.server.resident
+        assert tenant.server.warm_bytes > 0      # pre-eviction attribution
+        assert tenant.server._unit.result is None
+        assert tenant.server._standby is None    # standby dropped too
+        r = await c.get("/residency.json")
+        doc = await r.json()
+        assert doc["residentBytes"] == 0
+        assert doc["tenants"][0]["warmBytes"] > 0
+
+        # next hits drive the single-flight reload, then answers match
+        after = {u: await scores(c, "alpha", user=u)
+                 for u in ("u1", "u5", "nosuchuser")}
+        assert after == before
+        assert tenant.server.resident
+        evictions = tenant.server._evict_total
+        assert evictions.value(reason="test") == 1
+        reloads = tenant.server._reload_total
+        assert reloads.value(status="warm_reload") == 1
+    finally:
+        await c.close()
+
+
+async def test_evict_refused_mid_canary_and_mid_reload(mt_store):
+    host = make_host([make_tenant_spec("alpha", seed=1)])
+    server = host.tenants["alpha"].server
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        # a reload latch in flight refuses a second eviction
+        server._reload_event = asyncio.Event()
+        assert await server.evict_to_warm() is False
+        server._reload_event.set()
+        server._reload_event = None
+        # a canary window refuses eviction (the judge needs its baseline)
+        server._canary = object.__new__(
+            type("C", (), {}))  # truthy sentinel; only `is not None` read
+        assert await server.evict_to_warm() is False
+        server._canary = None
+        # an already-warm unit refuses a double evict
+        assert await server.evict_to_warm() is True
+        assert await server.evict_to_warm() is False
+    finally:
+        await c.close()
+
+
+async def test_reload_timeout_answers_503_with_retry_after(mt_store):
+    """Queries during a stuck reload are wait-bounded: past the bound
+    the client gets a clean 503 + Retry-After, and once the reload
+    completes the tenant serves again."""
+    host = make_host([make_tenant_spec("alpha", seed=1)],
+                     reload_wait_s=0.2)
+    server = host.tenants["alpha"].server
+    gate = asyncio.Event()
+    real_prepare = server._prepare_unit
+
+    async def stalled_prepare(*args, **kwargs):
+        await gate.wait()
+        return await real_prepare(*args, **kwargs)
+
+    server._prepare_unit = stalled_prepare
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        baseline = await scores(c, "alpha")
+        assert await server.evict_to_warm() is True
+        r = await query(c, "alpha")
+        assert r.status == 503
+        assert "Retry-After" in r.headers
+        assert host._reload_timeouts.value(tenant="alpha") == 1
+        gate.set()                      # un-stick the in-flight reload
+        deadline = time.monotonic() + 5
+        while not server.resident and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert await scores(c, "alpha") == baseline
+    finally:
+        gate.set()
+        await c.close()
+
+
+async def test_kill_points_never_half_resident(mt_store, device_resident):
+    """Chaos at all four evict/reload boundaries: whatever side of the
+    kill the state landed on, the active unit is either fully resident
+    or fully warm, and the NEXT query cycle recovers to the same
+    answers."""
+    host = make_host([make_tenant_spec("alpha", seed=1)])
+    server = host.tenants["alpha"].server
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        baseline = await scores(c, "alpha")
+
+        for point in ("mt:evict:drained", "mt:evict:committed"):
+            assert server.resident
+            set_kill_points([point])
+            with pytest.raises(CrashError):
+                await server.evict_to_warm("chaos")
+            set_kill_points([])
+            # both sides of either kill: the serving reference is the
+            # warm placeholder — never a half-unit
+            assert server._unit.result is None
+            assert not server.resident
+            # recovery: the next query reloads and answers identically
+            assert await scores(c, "alpha") == baseline
+            assert server.resident
+
+        for point in ("mt:reload:loaded", "mt:reload:committed"):
+            assert await server.evict_to_warm("chaos") is True
+            set_kill_points([point])
+            ev = asyncio.Event()
+            server._reload_event = ev
+            with pytest.raises(CrashError):
+                await server._reload_from_warm(ev)
+            set_kill_points([])
+            # the latch always clears (waiters wake either way) and the
+            # unit is fully warm OR fully resident, by kill side
+            assert ev.is_set()
+            assert server._reload_event is None
+            if point == "mt:reload:loaded":
+                assert server._unit.result is None      # swap never ran
+            else:
+                assert server.resident                  # swap committed
+            assert await scores(c, "alpha") == baseline
+            assert server.resident
+    finally:
+        set_kill_points([])
+        await c.close()
+
+
+async def test_deploy_racing_warm_reload_wins(mt_store):
+    """The swap-vs-evict race under the _swap_lock discipline: a deploy
+    that lands while a warm reload is in flight must win — the reloaded
+    unit is discarded (counted raced), never silently installed over
+    the newer release."""
+    host = make_host([make_tenant_spec("alpha", seed=1)])
+    server = host.tenants["alpha"].server
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        await scores(c, "alpha")
+        assert await server.evict_to_warm() is True
+        warm = server._unit
+
+        hold = asyncio.Event()
+        real_prepare = server._prepare_unit
+
+        async def slow_prepare(*args, **kwargs):
+            unit = await real_prepare(*args, **kwargs)
+            await hold.wait()
+            return unit
+
+        server._prepare_unit = slow_prepare
+        ev = asyncio.Event()
+        server._reload_event = ev
+        reload_task = asyncio.get_running_loop().create_task(
+            server._reload_from_warm(ev))
+        await asyncio.sleep(0.05)
+
+        # the racing deploy: a fresh unit swapped in while the reload
+        # is still holding its prepared unit
+        server._prepare_unit = real_prepare
+        deployed = await server._prepare_unit(server._unit.instance,
+                                              server._unit.release)
+        server._swap_to(deployed, mode="deploy", reason="race-test")
+        assert server._unit is deployed
+
+        server._prepare_unit = slow_prepare
+        hold.set()
+        await reload_task
+        # the deploy's unit is still live; the reload discarded its own
+        assert server._unit is deployed
+        assert server._unit is not warm
+        assert server._reload_total.value(status="warm_reload_raced") == 1
+        assert server.resident
+        assert await scores(c, "alpha")
+    finally:
+        hold.set()
+        await c.close()
+
+
+# ---------------------------------------------------------------------------
+# the residency budgeter
+# ---------------------------------------------------------------------------
+
+async def test_budget_lru_eviction_on_miss_and_sweep(
+        mt_store, device_resident):
+    """An undersized budget: the sweep evicts the least-recently-queried
+    tenant down to the budget, and a miss on the evicted tenant makes
+    room by evicting the NEXT least-recent — one budget, N tenants,
+    queries keep answering."""
+    host = make_host([make_tenant_spec("alpha", seed=1, n_items=300),
+                      make_tenant_spec("beta", seed=2, n_items=300)])
+    alpha, beta = host.tenants["alpha"], host.tenants["beta"]
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        a_scores = await scores(c, "alpha")
+        b_scores = await scores(c, "beta")
+        a_bytes = alpha.server.warm_bytes
+        b_bytes = beta.server.warm_bytes
+        assert a_bytes > 0 and b_bytes > 0
+        # a budget that fits ONE tenant but not both
+        host.config.budget_bytes = int(max(a_bytes, b_bytes) * 1.5)
+        assert a_bytes + b_bytes > host.config.budget_bytes
+
+        # freshen alpha, then sweep: beta is the LRU victim
+        await scores(c, "alpha")
+        await host.enforce_budget()
+        assert alpha.server.resident
+        assert not beta.server.resident
+        assert host.resident_bytes() <= host.config.budget_bytes
+
+        # miss on beta: the budgeter makes room by evicting alpha (the
+        # projection uses beta's remembered footprint), then reloads
+        assert await scores(c, "beta") == b_scores
+        assert beta.server.resident
+        assert not alpha.server.resident
+
+        # and back: the cycle is stable in both directions
+        assert await scores(c, "alpha") == a_scores
+        assert alpha.server.resident
+        assert not beta.server.resident
+    finally:
+        await c.close()
+
+
+async def test_min_resident_floor_holds(mt_store, device_resident):
+    """The sweep never evicts below min_resident even when the budget is
+    absurdly small — some tenant must keep serving."""
+    host = make_host([make_tenant_spec("alpha", seed=1)],
+                     min_resident=1)
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        await scores(c, "alpha")
+        host.config.budget_bytes = 1          # nothing fits
+        await host.enforce_budget()
+        assert host.tenants["alpha"].server.resident
+    finally:
+        await c.close()
+
+
+# ---------------------------------------------------------------------------
+# admission control (the SLO-burn 429 path)
+# ---------------------------------------------------------------------------
+
+SLO = {"objectives": [{"name": "errors", "kind": "errors",
+                       "budget": 0.1}],
+       "windows": [{"seconds": 60, "burnThreshold": 1.0}],
+       "evalIntervalS": 60}
+
+
+async def test_burning_tenant_is_shed_quiet_tenant_unaffected(mt_store):
+    """Prove the e2e: a tenant burning its error budget gets 429 +
+    Retry-After at the gate; the co-hosted quiet tenant keeps answering
+    200; shed queries are NOT counted as tenant failures (the burn can
+    recover)."""
+    host = make_host([make_tenant_spec("noisy", seed=1, slo=SLO),
+                      make_tenant_spec("quiet", seed=2, slo=SLO)],
+                     admission=True, retry_after_s=2.0)
+    noisy = host.tenants["noisy"]
+    assert noisy.slo is not None
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        t0 = time.monotonic()
+        noisy.slo.tick(now=t0)
+        # burn: malformed queries answer 400 through the gate
+        for _ in range(5):
+            r = await c.post("/t/noisy/queries.json",
+                             data=b"{not json", headers={
+                                 "Content-Type": "application/json"})
+            assert r.status == 400
+        noisy.slo.tick(now=t0 + 31)
+        assert noisy.slo.breached(exclude_kinds=("freshness",))
+
+        r = await query(c, "noisy")
+        assert r.status == 429
+        assert r.headers["Retry-After"] == "2"
+        assert host._rejected.value(tenant="noisy") == 1
+        # shed queries are not failures — else the burn never recovers
+        assert host._failures.value(tenant="noisy") == 5
+
+        # the co-hosted quiet tenant is untouched
+        assert await scores(c, "quiet")
+        assert host._rejected.value(tenant="quiet") == 0
+
+        # admission off: the same burning tenant serves again
+        host.config.admission = False
+        assert await scores(c, "noisy")
+    finally:
+        await c.close()
+
+
+# ---------------------------------------------------------------------------
+# tenant label cardinality (the max_series overflow guard)
+# ---------------------------------------------------------------------------
+
+async def test_tenant_label_explosion_collapses_to_other(mt_store):
+    """The `tenant` label rides the registry's max_series guard: the
+    host wires PIO_MT_MAX_TENANT_SERIES onto every tenant-labelled
+    metric, an explosion collapses NEW tenants into `other`, and the
+    established tenants' series survive intact."""
+    host = make_host([make_tenant_spec("alpha", seed=1),
+                      make_tenant_spec("beta", seed=2)],
+                     max_tenant_series=2)
+    assert host._queries.max_series == 2
+    assert host._hist.max_series == 2
+    c = TestClient(TestServer(host.app))
+    await c.start_server()
+    try:
+        await scores(c, "alpha")
+        await scores(c, "beta")
+        assert host._queries.value(tenant="alpha") == 1
+        assert host._queries.value(tenant="beta") == 1
+
+        # explosion: a flood of novel tenant values (what a bad rollout
+        # of machine-generated tenant names would do to the registry)
+        for i in range(40):
+            host._queries.inc(tenant=f"exploded-{i}")
+        assert host._queries.value(tenant="other") == 40
+        assert host._queries.series_count() == 3   # alpha, beta, other
+        # established tenants' series survive the flood
+        await scores(c, "alpha")
+        assert host._queries.value(tenant="alpha") == 2
+        assert host._queries.value(tenant="beta") == 1
+        # and the overflow is observable, per metric
+        overflow = host.registry.get("pio_obs_label_overflow_total")
+        assert overflow.value(metric="pio_tenant_queries_total") == 40
+    finally:
+        await c.close()
+
+
+# ---------------------------------------------------------------------------
+# config precedence
+# ---------------------------------------------------------------------------
+
+def test_multitenant_config_precedence(monkeypatch):
+    cfg = MultiTenantConfig.from_env({"budgetBytes": 1024,
+                                      "reloadWaitS": 3.0,
+                                      "admission": False})
+    assert cfg.budget_bytes == 1024 and cfg.reload_wait_s == 3.0
+    assert not cfg.admission
+    # env beats the file section; malformed env logged + ignored
+    monkeypatch.setenv("PIO_MT_DEVICE_BUDGET_BYTES", "2048")
+    monkeypatch.setenv("PIO_MT_SWEEP_INTERVAL_S", "junk")
+    monkeypatch.setenv("PIO_MT_MIN_RESIDENT", "3")
+    cfg = MultiTenantConfig.from_env({"budgetBytes": 1024,
+                                      "sweepIntervalS": 7.0})
+    assert cfg.budget_bytes == 2048
+    assert cfg.sweep_interval_s == 7.0
+    assert cfg.min_resident == 3
+    monkeypatch.setenv("PIO_MT_MAX_TENANT_SERIES", "0")
+    assert MultiTenantConfig.from_env().max_tenant_series == 1  # clamped
